@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's localhost-server trick for multi-node testing
+(SURVEY.md §4): a CPU backend with 8 fake devices stands in for a v5e-8
+TPU mesh so sharding/collective code paths compile and run in CI.
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from trivy_tpu.parallel.mesh import make_mesh
+    assert len(jax.devices()) >= 8
+    return make_mesh(8)

@@ -1,0 +1,23 @@
+"""trivy_tpu — a TPU-native security-scanning framework.
+
+A brand-new framework with the capabilities of the reference scanner
+(aquasecurity/trivy, Go): container image / filesystem / repo / SBOM /
+Kubernetes scanning for vulnerabilities, secrets, misconfigurations and
+licenses — with the two hot loops re-designed TPU-first:
+
+* secret detection: multi-pattern regex matching compiled to DFAs and
+  batch-executed on TPU over flattened, segment-padded byte buffers
+  (``trivy_tpu.ops.dfa``), with sparse host-side verification for exact
+  span/group parity;
+* vulnerability detection: package→advisory version-constraint matching
+  as vectorized fixed-width version-key interval intersection
+  (``trivy_tpu.ops.vercmp``) over a flattened advisory table.
+
+Host-side (Python) does the irregular work: tar walking, parsers,
+caching, report writing — mirroring the reference's layering
+(see SURVEY.md §1) but organized for a batch-dispatch TPU runtime.
+"""
+
+__version__ = "0.1.0"
+
+SCHEMA_VERSION = 2

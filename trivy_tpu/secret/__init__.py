@@ -1,0 +1,23 @@
+"""Secret detection engine.
+
+CPU path: exact reference semantics (pkg/fanal/secret/scanner.go).
+TPU path: DFA hit-detection kernel (trivy_tpu.ops.dfa) + sparse host
+verification, orchestrated by trivy_tpu.secret.batch.
+"""
+
+from .model import (
+    Rule,
+    AllowRule,
+    ExcludeBlock,
+    Location,
+    SecretConfig,
+    load_config,
+)
+from .scanner import Scanner, new_scanner
+from .builtin_rules import BUILTIN_RULES, BUILTIN_ALLOW_RULES
+
+__all__ = [
+    "Rule", "AllowRule", "ExcludeBlock", "Location", "SecretConfig",
+    "load_config", "Scanner", "new_scanner", "BUILTIN_RULES",
+    "BUILTIN_ALLOW_RULES",
+]

@@ -1,0 +1,163 @@
+"""Secret rule model + config file loading.
+
+Behavioral contract mirrors the reference rule schema
+(pkg/fanal/secret/scanner.go:83-94: Rule{ID, Category, Severity, Regex,
+Keywords, Path, AllowRules, ExcludeBlock, SecretGroupName}) and the
+`trivy-secret.yaml` config format (ParseConfig, scanner.go:267-291).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover
+    yaml = None
+
+
+@dataclass(frozen=True)
+class Location:
+    start: int
+    end: int
+
+    def contains(self, other: "Location") -> bool:
+        return self.start <= other.start and other.end <= self.end
+
+
+@dataclass
+class AllowRule:
+    id: str = ""
+    description: str = ""
+    regex: Optional[re.Pattern] = None
+    path: Optional[re.Pattern] = None
+
+
+@dataclass
+class ExcludeBlock:
+    description: str = ""
+    regexes: list = field(default_factory=list)
+
+
+@dataclass
+class Rule:
+    id: str
+    category: str = ""
+    title: str = ""
+    severity: str = ""
+    regex: Optional[re.Pattern] = None
+    keywords: list = field(default_factory=list)
+    path: Optional[re.Pattern] = None
+    allow_rules: list = field(default_factory=list)
+    exclude_block: ExcludeBlock = field(default_factory=ExcludeBlock)
+    secret_group_name: str = ""
+
+    # --- gating helpers (reference: scanner.go:160-184) ---
+
+    def match_path(self, path: str) -> bool:
+        return self.path is None or self.path.search(path) is not None
+
+    def match_keywords(self, lowered: bytes) -> bool:
+        """Substring prefilter over the lowercased content.
+        Caller passes content.lower() once per file (reference lowercases
+        per rule; hoisting it is behavior-identical)."""
+        if not self.keywords:
+            return True
+        return any(kw.lower().encode() in lowered for kw in self.keywords)
+
+    def allow_path(self, path: str) -> bool:
+        return _allow_path(self.allow_rules, path)
+
+    def allow(self, match: str) -> bool:
+        return _allow_match(self.allow_rules, match)
+
+
+def _allow_path(rules: list, path: str) -> bool:
+    return any(r.path is not None and r.path.search(path) for r in rules)
+
+
+def _allow_match(rules: list, match: str) -> bool:
+    return any(r.regex is not None and r.regex.search(match) for r in rules)
+
+
+def compile_rx(pattern: str) -> re.Pattern:
+    """Compile a rule regex.
+
+    Rules are authored in a Python/RE2-common subset. Mid-pattern global
+    ``(?i)`` (legal in RE2, rejected by Python ≥3.11) is normalized to a
+    scoped group over the pattern tail.
+    """
+    try:
+        return re.compile(pattern)
+    except re.error:
+        idx = pattern.find("(?i)")
+        if idx > 0:
+            head, tail = pattern[:idx], pattern[idx + 4:]
+            return re.compile(f"{head}(?i:{tail})")
+        raise
+
+
+@dataclass
+class SecretConfig:
+    """Parsed trivy-secret.yaml."""
+
+    enable_builtin_rule_ids: list = field(default_factory=list)
+    disable_rule_ids: list = field(default_factory=list)
+    disable_allow_rule_ids: list = field(default_factory=list)
+    custom_rules: list = field(default_factory=list)
+    custom_allow_rules: list = field(default_factory=list)
+    exclude_block: ExcludeBlock = field(default_factory=ExcludeBlock)
+
+
+def _parse_allow_rule(d: dict) -> AllowRule:
+    return AllowRule(
+        id=d.get("id", ""),
+        description=d.get("description", ""),
+        regex=compile_rx(d["regex"]) if d.get("regex") else None,
+        path=compile_rx(d["path"]) if d.get("path") else None,
+    )
+
+
+def _parse_exclude_block(d: dict) -> ExcludeBlock:
+    return ExcludeBlock(
+        description=d.get("description", ""),
+        regexes=[compile_rx(r) for r in d.get("regexes", [])],
+    )
+
+
+def _parse_rule(d: dict) -> Rule:
+    return Rule(
+        id=d.get("id", ""),
+        category=d.get("category", ""),
+        title=d.get("title", ""),
+        severity=d.get("severity", ""),
+        regex=compile_rx(d["regex"]) if d.get("regex") else None,
+        keywords=list(d.get("keywords", [])),
+        path=compile_rx(d["path"]) if d.get("path") else None,
+        allow_rules=[_parse_allow_rule(a) for a in d.get("allow-rules", [])],
+        exclude_block=_parse_exclude_block(d.get("exclude-block", {})),
+        secret_group_name=d.get("secret-group-name", ""),
+    )
+
+
+def load_config(path: str) -> Optional[SecretConfig]:
+    """Load trivy-secret.yaml; None means "use builtins only"
+    (missing file is not an error — reference: scanner.go:273-277)."""
+    if not path:
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = yaml.safe_load(f) or {}
+    except FileNotFoundError:
+        return None
+    return SecretConfig(
+        enable_builtin_rule_ids=list(raw.get("enable-builtin-rules", [])),
+        disable_rule_ids=list(raw.get("disable-rules", [])),
+        disable_allow_rule_ids=list(raw.get("disable-allow-rules", [])),
+        custom_rules=[_parse_rule(r) for r in raw.get("rules", [])],
+        custom_allow_rules=[_parse_allow_rule(a)
+                            for a in raw.get("allow-rules", [])],
+        exclude_block=_parse_exclude_block(raw.get("exclude-block", {})),
+    )

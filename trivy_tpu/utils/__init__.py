@@ -1,0 +1,3 @@
+from .log import get_logger, set_level
+
+__all__ = ["get_logger", "set_level"]

@@ -1,0 +1,30 @@
+"""Logging setup (reference analog: pkg/log zap SugaredLogger)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FMT = "%(asctime)s\t%(levelname)s\t%(message)s"
+_DATEFMT = "%Y-%m-%dT%H:%M:%S"
+
+_root = logging.getLogger("trivy_tpu")
+if not _root.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    _root.addHandler(_h)
+    _root.setLevel(logging.INFO)
+    _root.propagate = False
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return _root.getChild(name) if name else _root
+
+
+def set_level(debug: bool = False, quiet: bool = False) -> None:
+    if quiet:
+        _root.setLevel(logging.ERROR)
+    elif debug:
+        _root.setLevel(logging.DEBUG)
+    else:
+        _root.setLevel(logging.INFO)
