@@ -188,3 +188,13 @@ def test_multiline_match_line_truncation(scanner):
 
 def test_empty_content_no_findings(scanner):
     assert scanner.scan("f", b"").findings == []
+
+
+def test_mid_pattern_icase_group_scope(scanner):
+    # Regression: the (?i) splice must close inside the enclosing group,
+    # or the named secret group swallows trailing context/newlines.
+    res = scanner.scan("cfg", b"id LTAIabcdefghij0123456789\nnextline\n")
+    assert find_ids(res) == ["alibaba-access-key-id"]
+    f = res.findings[0]
+    assert f.start_line == 1 and f.end_line == 1
+    assert "nextline" not in f.match

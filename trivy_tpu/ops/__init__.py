@@ -1,0 +1,10 @@
+"""TPU kernels (JAX/XLA, with Pallas variants where they win).
+
+* ``dfa``    — batched multi-pattern DFA scanning (secret detection).
+* ``vercmp`` — vectorized version-constraint matching (vulnerability
+  detection).
+"""
+
+from . import dfa  # noqa: F401
+
+__all__ = ["dfa"]

@@ -1,0 +1,158 @@
+"""Batched secret scanning: TPU hit-detection + sparse host verification.
+
+Pipeline (the TPU re-design of the reference's per-file scan loop,
+pkg/fanal/secret/scanner.go:341):
+
+  1. files → fixed-size overlapping segments in one [B, L] uint8 buffer
+     (the "sequence dimension" of this domain — SURVEY.md §5);
+  2. one kernel dispatch advances every rule-group DFA over every
+     segment (trivy_tpu.ops.dfa);
+  3. hit (segment, group, bit) triples decode to (file, rule)
+     candidates; host re-runs the CPU-exact engine per candidate file
+     restricted to its candidate rules — byte-identical findings,
+     because rules with no DFA hit can contribute neither findings nor
+     censoring.
+
+Fallback rules (host-only DFAs, e.g. private-key) are appended to every
+file's candidate set, pre-gated by their keyword prefilter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..utils import get_logger
+from .model import Rule
+from .rx import RulePack, load_or_compile
+from .scanner import Scanner
+
+log = get_logger("secret.batch")
+
+SEG_LEN = 2048      # segment length in bytes
+MIN_OVERLAP = 192   # must be ≥ pack.max_window (asserted)
+
+
+@dataclass
+class _FileEntry:
+    path: str
+    content: bytes
+    index: int
+
+
+class BatchSecretScanner:
+    """Scans many files per kernel dispatch. API mirrors Scanner.scan
+    but over a batch; results are CPU-engine-identical."""
+
+    def __init__(self, scanner: Optional[Scanner] = None,
+                 seg_len: int = SEG_LEN, backend: str = "tpu"):
+        if scanner is None:
+            from .scanner import new_scanner
+            scanner = new_scanner()
+        self.scanner = scanner
+        self.backend = backend
+        self.pack: RulePack = load_or_compile(self.scanner.rules)
+        self.overlap = max(MIN_OVERLAP, self.pack.max_window)
+        self.seg_len = max(seg_len, 2 * self.overlap)
+        self._jax_tables = None
+
+    # --- segmenting ---
+
+    def _segment(self, files: list) -> tuple:
+        """Flatten files into [B, L] uint8 with per-file overlap chaining."""
+        seg_file: list = []
+        chunks: list = []
+        step = self.seg_len - self.overlap
+        for fe in files:
+            n = len(fe.content)
+            if n == 0:
+                continue
+            pos = 0
+            while True:
+                chunk = fe.content[pos:pos + self.seg_len]
+                chunks.append(chunk)
+                seg_file.append(fe.index)
+                if pos + self.seg_len >= n:
+                    break
+                pos += step
+        if not chunks:
+            return np.zeros((0, self.seg_len), np.uint8), []
+        B = len(chunks)
+        buf = np.zeros((B, self.seg_len), np.uint8)
+        for i, c in enumerate(chunks):
+            buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        return buf, seg_file
+
+    # --- kernel dispatch ---
+
+    def _tables(self):
+        if self._jax_tables is None:
+            import jax.numpy as jnp
+            p = self.pack
+            self._jax_tables = (jnp.asarray(p.class_maps),
+                                jnp.asarray(p.trans),
+                                jnp.asarray(p.accept))
+        return self._jax_tables
+
+    def _kernel_hits(self, buf: np.ndarray) -> np.ndarray:
+        """[B, L] → [B, G] uint32 hit masks."""
+        if self.pack.n_groups == 0 or buf.shape[0] == 0:
+            return np.zeros((buf.shape[0], 0), np.uint32)
+        if self.backend == "cpu-ref":
+            from ..ops.dfa import dfa_hits_host
+            p = self.pack
+            return dfa_hits_host(buf, p.class_maps, p.trans, p.accept)
+        import jax.numpy as jnp
+        from ..ops.dfa import dfa_hits
+        cmaps, trans, accept = self._tables()
+        return np.asarray(dfa_hits(jnp.asarray(buf), cmaps, trans, accept))
+
+    # --- the public API ---
+
+    def scan_files(self, files: Iterable) -> list:
+        """``files``: iterable of (path, content-bytes).
+        Returns list of types.Secret (only files with findings)."""
+        entries = [
+            _FileEntry(path=p, content=c, index=i)
+            for i, (p, c) in enumerate(files)
+        ]
+        candidates = self._candidates(entries)
+
+        results = []
+        for fe in entries:
+            rule_idxs = candidates.get(fe.index)
+            if not rule_idxs:
+                continue
+            rules = [self.scanner.rules[i] for i in sorted(rule_idxs)]
+            sub = Scanner(rules, self.scanner.allow_rules,
+                          self.scanner.exclude_block)
+            secret = sub.scan(fe.path, fe.content)
+            if secret.findings:
+                results.append(secret)
+        return results
+
+    def _candidates(self, entries: list) -> dict:
+        """file index → set of candidate rule indices."""
+        candidates: dict = {}
+
+        buf, seg_file = self._segment(entries)
+        if buf.shape[0]:
+            hits = self._kernel_hits(buf)
+            nonzero = np.nonzero(hits.any(axis=1))[0]
+            for si in nonzero:
+                fidx = seg_file[si]
+                rids = self.pack.decode_hits(hits[si])
+                if rids:
+                    candidates.setdefault(fidx, set()).update(rids)
+
+        # Host-fallback rules: keyword-gated exact scan per file.
+        if self.pack.fallback_rules:
+            for fe in entries:
+                lowered = fe.content.lower()
+                for ri in self.pack.fallback_rules:
+                    rule = self.scanner.rules[ri]
+                    if rule.match_keywords(lowered):
+                        candidates.setdefault(fe.index, set()).add(ri)
+        return candidates

@@ -82,20 +82,56 @@ def _allow_match(rules: list, match: str) -> bool:
     return any(r.regex is not None and r.regex.search(match) for r in rules)
 
 
+def _icase_scope_end(tail: str) -> int:
+    """Index in ``tail`` of the first ``)`` that closes an ENCLOSING
+    group — the point where a spliced ``(?i:`` scope must end so group
+    nesting survives. Skips escapes and char classes."""
+    depth = 0
+    i = 0
+    n = len(tail)
+    while i < n:
+        c = tail[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == "[":
+            i += 1
+            if i < n and tail[i] == "^":
+                i += 1
+            if i < n and tail[i] == "]":  # literal ] first in class
+                i += 1
+            while i < n and tail[i] != "]":
+                i += 2 if tail[i] == "\\" else 1
+            i += 1
+            continue
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            if depth == 0:
+                return i
+            depth -= 1
+        i += 1
+    return n
+
+
 def compile_rx(pattern: str) -> re.Pattern:
     """Compile a rule regex.
 
     Rules are authored in a Python/RE2-common subset. Mid-pattern global
     ``(?i)`` (legal in RE2, rejected by Python ≥3.11) is normalized to a
-    scoped group over the pattern tail.
-    """
+    scoped ``(?i:…)`` group closing at the end of the enclosing group,
+    so nesting is preserved (RE2 would extend the flag to the very end
+    of the pattern; the difference is immaterial for case-invariant
+    trailing context, which is all the builtin rules use)."""
     try:
         return re.compile(pattern)
     except re.error:
         idx = pattern.find("(?i)")
         if idx > 0:
             head, tail = pattern[:idx], pattern[idx + 4:]
-            return re.compile(f"{head}(?i:{tail})")
+            end = _icase_scope_end(tail)
+            return re.compile(
+                f"{head}(?i:{tail[:end]}){tail[end:]}")
         raise
 
 
@@ -147,6 +183,8 @@ def load_config(path: str) -> Optional[SecretConfig]:
     (missing file is not an error — reference: scanner.go:273-277)."""
     if not path:
         return None
+    if yaml is None:
+        raise RuntimeError("PyYAML is required for --secret-config")
     try:
         with open(path, "r", encoding="utf-8") as f:
             raw = yaml.safe_load(f) or {}

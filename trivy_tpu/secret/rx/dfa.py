@@ -1,0 +1,153 @@
+"""Subset-construction DFA with byte-class compression.
+
+Produces the flat integer tables the TPU kernel consumes:
+
+* ``class_map[256]`` — byte → equivalence class (bytes indistinguishable
+  to every edge of the group's NFA share a class);
+* ``trans[S, C]``    — dense next-state table;
+* ``accept[S]``      — uint32 bitmask of rules matched *at* this state.
+
+The kernel then advances a [batch]-vector of states with one gather per
+byte and ORs ``accept[state]`` into a hit mask — multi-pattern scanning
+as pure data-parallel table lookups (design rationale: SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .nfa import NFA
+
+
+class DFAOverflow(ValueError):
+    pass
+
+
+@dataclass
+class DFA:
+    n_states: int
+    n_classes: int
+    class_map: np.ndarray   # [256] int32
+    trans: np.ndarray       # [S, C] int32
+    accept: np.ndarray      # [S] uint32 bitmask over group rules
+    n_rules: int
+
+    def run(self, data: bytes) -> int:
+        """Host-side reference interpreter (for tests): returns the OR of
+        accept masks seen along the way."""
+        s = 0
+        hits = int(self.accept[0])
+        for b in data:
+            s = int(self.trans[s, self.class_map[b]])
+            hits |= int(self.accept[s])
+        return hits
+
+
+def _byte_classes(nfa: NFA) -> tuple:
+    """Partition 0..255 by which NFA edges accept each byte."""
+    sig = [0] * 256
+    for i, (_, byteset, _) in enumerate(nfa.edges):
+        for b in byteset:
+            sig[b] |= 1 << i
+    classes: dict = {}
+    class_map = np.zeros(256, dtype=np.int32)
+    reps = []
+    for b in range(256):
+        cid = classes.get(sig[b])
+        if cid is None:
+            cid = len(classes)
+            classes[sig[b]] = cid
+            reps.append(b)
+        class_map[b] = cid
+    return class_map, reps
+
+
+def _eps_closures(nfa: NFA) -> list:
+    """ε-closure per state as a bitmask int."""
+    n = nfa.n_states
+    closures = [0] * n
+    for s in range(n):
+        seen = 1 << s
+        stack = [s]
+        while stack:
+            u = stack.pop()
+            for v in nfa.eps[u]:
+                if not (seen >> v) & 1:
+                    seen |= 1 << v
+                    stack.append(v)
+        closures[s] = seen
+    return closures
+
+
+def build_dfa(nfa: NFA, max_states: int = 4096,
+              max_classes: int = 96) -> DFA:
+    class_map, reps = _byte_classes(nfa)
+    n_classes = len(reps)
+    if n_classes > max_classes:
+        raise DFAOverflow(f"{n_classes} byte classes")
+
+    closures = _eps_closures(nfa)
+
+    # move[s][c] = ε-closed target set for state s on class c
+    move = [dict() for _ in range(nfa.n_states)]
+    for (src, byteset, dst) in nfa.edges:
+        seen_classes = set()
+        for b in byteset:
+            c = int(class_map[b])
+            if c in seen_classes:
+                continue
+            seen_classes.add(c)
+            move[src][c] = move[src].get(c, 0) | closures[dst]
+
+    accept_masks = [0] * nfa.n_states
+    for state, bit in nfa.accept_bit.items():
+        accept_masks[state] = 1 << bit
+
+    def set_accept(mask: int) -> int:
+        out = 0
+        m = mask
+        while m:
+            lsb = m & -m
+            out |= accept_masks[lsb.bit_length() - 1]
+            m ^= lsb
+        return out
+
+    start = closures[0]
+    ids = {start: 0}
+    order = [start]
+    trans_rows = []
+    accepts = [set_accept(start)]
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = [0] * n_classes
+        for c in range(n_classes):
+            nxt = 0
+            m = cur
+            while m:
+                lsb = m & -m
+                s = lsb.bit_length() - 1
+                nxt |= move[s].get(c, 0)
+                m ^= lsb
+            tid = ids.get(nxt)
+            if tid is None:
+                tid = len(order)
+                if tid >= max_states:
+                    raise DFAOverflow(f">{max_states} DFA states")
+                ids[nxt] = tid
+                order.append(nxt)
+                accepts.append(set_accept(nxt))
+            row[c] = tid
+        trans_rows.append(row)
+
+    return DFA(
+        n_states=len(order),
+        n_classes=n_classes,
+        class_map=class_map,
+        trans=np.asarray(trans_rows, dtype=np.int32),
+        accept=np.asarray(accepts, dtype=np.uint32),
+        n_rules=nfa.n_rules,
+    )

@@ -1,0 +1,178 @@
+"""Thompson NFA construction with TPU-oriented over-approximation.
+
+The NFA built here recognizes a *superset* of the rule language:
+
+* ``Boundary`` nodes (``^ $ \\b \\B``) become ε — unanchored matching.
+* Counted repeats are capped (``{50,1000}`` → ``{8,}``, see ``REP_CAP``)
+  so subset construction can't explode into counting states.
+
+Both transforms only ever ADD strings to the language, preserving the
+no-false-negative property the TPU hit-detector requires (misses are
+impossible; spurious hits die in host-side exact re-matching).
+
+Multiple rules union into one NFA with per-rule accept bits, so a whole
+rule group compiles into a single DFA (Hyperscan-style multi-pattern
+matching, re-thought for TPU: the automaton becomes a gather table and
+the "scratch" is a [batch]-vector of states advancing in lock-step).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .parser import (
+    ALL_BYTES,
+    Alt,
+    Boundary,
+    Cat,
+    Empty,
+    Lit,
+    Node,
+    Rep,
+    parse,
+)
+
+# Counted repeats are the classic subset-construction blow-up: with an
+# unanchored `.*` prefix and a repeat charset that overlaps its own
+# prefix (e.g. `pscale_pw_[a-z0-9_.]{43}`), the DFA must track sets of
+# active counters — exponential states. For a *hit detector* we instead
+# cap the count: `X{m,n}` → `X{min(m,CAP),}` whenever n > CAP. That is a
+# strict superset language (no false negatives); precision beyond CAP
+# chars is delegated to host verification, which runs anyway.
+REP_CAP = 8
+STATE_LIMIT = 4000  # hard cap on NFA states per rule
+
+
+class NFATooLarge(ValueError):
+    pass
+
+
+@dataclass
+class NFA:
+    """ε-NFA over bytes. State 0 is the global start (with an all-bytes
+    self-loop for unanchored ``.*R`` search). ``accept_bit[s]`` maps an
+    accept state to its rule index within the group."""
+
+    n_states: int = 1
+    eps: list = field(default_factory=lambda: [[]])     # state -> [state]
+    edges: list = field(default_factory=list)           # (src, byteset, dst)
+    accept_bit: dict = field(default_factory=dict)      # state -> rule idx
+    n_rules: int = 0
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.n_states += 1
+        if self.n_states > STATE_LIMIT * max(1, self.n_rules):
+            raise NFATooLarge(f"{self.n_states} NFA states")
+        return self.n_states - 1
+
+    def add_eps(self, a: int, b: int) -> None:
+        self.eps[a].append(b)
+
+    def add_edge(self, a: int, byteset: frozenset, b: int) -> None:
+        if byteset:
+            self.edges.append((a, byteset, b))
+
+    # --- Thompson fragments: emit(node, in) -> out ---
+
+    def _emit(self, node: Node, entry: int) -> int:
+        if isinstance(node, Empty) or isinstance(node, Boundary):
+            return entry  # ε (Boundary relaxed — over-approximation)
+        if isinstance(node, Lit):
+            out = self.new_state()
+            self.add_edge(entry, node.bytes, out)
+            return out
+        if isinstance(node, Cat):
+            cur = entry
+            for part in node.parts:
+                cur = self._emit(part, cur)
+            return cur
+        if isinstance(node, Alt):
+            out = self.new_state()
+            for opt in node.options:
+                tail = self._emit(opt, entry)
+                self.add_eps(tail, out)
+            return out
+        if isinstance(node, Rep):
+            return self._emit_rep(node, entry)
+        raise TypeError(f"unknown node {node!r}")
+
+    def _emit_rep(self, node: Rep, entry: int) -> int:
+        lo, hi = node.min, node.max
+        if lo > REP_CAP:
+            lo, hi = REP_CAP, None   # over-approximate: {m,n} → {CAP,}
+        elif hi is not None and hi > REP_CAP:
+            hi = None                # over-approximate: {m,n} → {m,}
+        cur = entry
+        for _ in range(lo):
+            cur = self._emit(node.node, cur)
+        if hi is None:
+            # X* tail: loop body with skip
+            loop_in = self.new_state()
+            self.add_eps(cur, loop_in)
+            body_out = self._emit(node.node, loop_in)
+            self.add_eps(body_out, loop_in)
+            return loop_in
+        outs = [cur]
+        for _ in range(hi - lo):
+            cur = self._emit(node.node, cur)
+            outs.append(cur)
+        end = self.new_state()
+        for o in outs:
+            self.add_eps(o, end)
+        return end
+
+    def add_rule(self, pattern: str) -> int:
+        """Parse and add one rule; returns its bit index in the group."""
+        ast = relax_context(parse(pattern))
+        idx = self.n_rules
+        start = self.new_state()
+        self.add_eps(0, start)
+        out = self._emit(ast, start)
+        self.accept_bit[out] = idx
+        self.n_rules += 1
+        return idx
+
+
+def _nullable(node: Node) -> bool:
+    if isinstance(node, (Empty, Boundary)):
+        return True
+    if isinstance(node, Lit):
+        return False
+    if isinstance(node, Cat):
+        return all(_nullable(p) for p in node.parts)
+    if isinstance(node, Alt):
+        return any(_nullable(o) for o in node.options)
+    if isinstance(node, Rep):
+        return node.min == 0 or _nullable(node.node)
+    raise TypeError(node)
+
+
+def relax_context(ast: Node) -> Node:
+    """Drop head/tail context groups that admit a nullable alternative
+    (``(^|\\s+)…``, ``…(\\s+|$)``, ``([^0-9a-z]|^)…``).
+
+    With the unanchored ``.*`` search prefix these groups only constrain
+    the surrounding context of a token; dropping them admits a superset
+    (matches regardless of context) — exactly what a hit detector wants,
+    and it removes the unbounded leading/trailing runs that would
+    otherwise wreck the segment-overlap window bound."""
+    if isinstance(ast, Cat) and len(ast.parts) >= 2:
+        parts = list(ast.parts)
+        if isinstance(parts[0], Alt) and _nullable(parts[0]):
+            parts[0] = Empty()
+        if isinstance(parts[-1], Alt) and _nullable(parts[-1]):
+            parts[-1] = Empty()
+        return Cat(parts)
+    return ast
+
+
+def build_nfa(patterns: list) -> NFA:
+    """Union NFA for a group of patterns; state 0 carries the unanchored
+    search self-loop."""
+    nfa = NFA()
+    nfa.add_edge(0, frozenset(ALL_BYTES), 0)
+    for p in patterns:
+        nfa.add_rule(p)
+    return nfa

@@ -1,0 +1,282 @@
+"""Compile a full rule set into padded, grouped DFA tables for the TPU.
+
+Rules pack greedily into union-DFA groups (multi-pattern DFAs): each
+group is one automaton scanning for up to 32 rules simultaneously, so
+kernel cost scales with #groups, not #rules. A rule that can't compile
+(unsupported syntax, state blow-up) falls back to host-side scanning,
+gated by its keyword prefilter — behavior is identical either way, only
+the filtering venue changes.
+
+Tables are padded to common [G, S, C] shapes for a single vmapped kernel
+dispatch. Padded table entries self-loop at state 0 with accept 0, so a
+"dead" group lane is harmless.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .dfa import DFA, DFAOverflow, build_dfa
+from .nfa import REP_CAP, NFATooLarge, build_nfa, relax_context
+from .parser import (
+    Alt,
+    Boundary,
+    Cat,
+    Empty,
+    Lit,
+    RegexParseError,
+    Rep,
+    parse,
+)
+
+GROUP_RULE_CAP = 24        # ≤32 for the uint32 accept mask, with margin
+GROUP_STATE_CAP = 3072     # per-group DFA state budget
+GROUP_CLASS_CAP = 96       # per-group byte-class budget
+WINDOW_CAP = 192          # max original match window coverable by overlap
+
+INF = float("inf")
+
+
+def _relaxed_min_len(node) -> int:
+    """Min accepted length under the REP_CAP relaxation — how little the
+    DFA can get away with consuming for this subpattern."""
+    if isinstance(node, (Boundary, Empty)):
+        return 0
+    if isinstance(node, Lit):
+        return 1
+    if isinstance(node, Alt):
+        return min(_relaxed_min_len(o) for o in node.options)
+    if isinstance(node, Cat):
+        return sum(_relaxed_min_len(p) for p in node.parts)
+    if isinstance(node, Rep):
+        return min(node.min, REP_CAP) * _relaxed_min_len(node.node)
+    raise TypeError(node)
+
+
+def _forced_max(node, req_after: int) -> float:
+    """Witness-window bound: the longest prefix of an ORIGINAL match the
+    relaxed DFA may need to consume before it can accept.
+
+    Any original match T[i:j] is itself in the relaxed language, and the
+    DFA may stop a repeat early only when everything after it is
+    relaxed-nullable (``req_after == 0``). So: a repeat followed by
+    required content contributes its full ORIGINAL extent (INF when
+    unbounded — private-key's body ``+`` before the END marker → host
+    fallback); a tail repeat contributes only its relaxed minimum (pypi's
+    {50,1000} tail → 8 bytes, aws's trailing ``(\\s+|$)`` → 1 byte)."""
+    if isinstance(node, (Boundary, Empty)):
+        return 0
+    if isinstance(node, Lit):
+        return 1
+    if isinstance(node, Alt):
+        return max(_forced_max(o, req_after) for o in node.options)
+    if isinstance(node, Cat):
+        total: float = 0
+        suffix_req = req_after
+        contributions = []
+        for p in reversed(node.parts):
+            contributions.append(_forced_max(p, suffix_req))
+            suffix_req += _relaxed_min_len(p)
+        return sum(contributions)
+    if isinstance(node, Rep):
+        lo, hi = node.min, node.max
+        inner_req = 1 if max(lo, 1) > 1 else 0
+        child = _forced_max(node.node, inner_req)
+        if req_after > 0:
+            if hi is None:
+                # Interior whitespace runs (`key\s*=\s*val`) are treated
+                # as practically bounded: a >WS_RUN_CAP gap inside a match
+                # that ALSO straddles a segment boundary is the one
+                # accepted approximation in the overlap guarantee.
+                if _is_space_run(node.node):
+                    return WS_RUN_CAP
+                return INF
+            return hi * child
+        return min(lo, REP_CAP) * child
+    raise TypeError(node)
+
+
+def _is_space_run(node) -> bool:
+    return isinstance(node, Lit) and node.bytes <= _SPACE_SET
+
+
+_SPACE_SET = frozenset(b" \t\n\r\f\v")
+WS_RUN_CAP = 64
+
+
+def rule_window(pattern: str) -> float:
+    """Max witness window (bytes); INF → host fallback."""
+    return _forced_max(relax_context(parse(pattern)), 0)
+
+
+@dataclass
+class RulePack:
+    """Compiled tables + bookkeeping mapping (group, bit) back to rules."""
+
+    n_groups: int
+    class_maps: np.ndarray          # [G, 256] int32
+    trans: np.ndarray               # [G, S_max, C_max] int32
+    accept: np.ndarray              # [G, S_max] uint32
+    group_rules: list               # G lists of rule indices (global)
+    fallback_rules: list            # rule indices compiled host-only
+    rule_ids: list                  # global index -> rule id string
+    s_max: int = 0
+    c_max: int = 0
+    max_window: int = 0             # segment overlap must be ≥ this
+
+    def decode_hits(self, hit_masks) -> list:
+        """[G] uint32 per segment → list of global rule indices."""
+        out = []
+        for g, mask in enumerate(hit_masks):
+            m = int(mask)
+            while m:
+                lsb = m & -m
+                out.append(self.group_rules[g][lsb.bit_length() - 1])
+                m ^= lsb
+        return out
+
+def _try_group(patterns: list) -> Optional[DFA]:
+    try:
+        nfa = build_nfa(patterns)
+        return build_dfa(nfa, max_states=GROUP_STATE_CAP,
+                         max_classes=GROUP_CLASS_CAP)
+    except (DFAOverflow, NFATooLarge, RegexParseError):
+        return None
+
+
+def compile_rules(rules: list) -> RulePack:
+    """``rules``: list of objects with ``.id`` and ``.regex`` (compiled
+    Python pattern whose ``.pattern`` we re-parse) — i.e. secret.Rule."""
+    rule_ids = [r.id for r in rules]
+    fallback: list = []
+
+    # First: which rules compile standalone at all, with a bounded
+    # match window that segment overlap can cover?
+    compilable: list = []   # (global_idx, pattern)
+    max_window = 0
+    for i, r in enumerate(rules):
+        if r.regex is None:
+            fallback.append(i)
+            continue
+        pat = r.regex.pattern
+        try:
+            window = rule_window(pat)
+        except (RegexParseError, TypeError):
+            fallback.append(i)
+            continue
+        if window == INF or window > WINDOW_CAP or \
+                _try_group([pat]) is None:
+            fallback.append(i)
+        else:
+            max_window = max(max_window, int(window))
+            compilable.append((i, pat))
+
+    # Greedy packing: grow a group until adding a rule overflows it.
+    groups: list = []       # list of (rule_idx list, DFA)
+    cur_idx: list = []
+    cur_pat: list = []
+    cur_dfa: Optional[DFA] = None
+    for gi, pat in compilable:
+        trial_idx = cur_idx + [gi]
+        trial_pat = cur_pat + [pat]
+        dfa = None
+        if len(trial_idx) <= GROUP_RULE_CAP:
+            dfa = _try_group(trial_pat)
+        if dfa is None:
+            if cur_dfa is not None:
+                groups.append((cur_idx, cur_dfa))
+            cur_idx, cur_pat = [gi], [pat]
+            cur_dfa = _try_group(cur_pat)
+            assert cur_dfa is not None  # compiled standalone above
+        else:
+            cur_idx, cur_pat, cur_dfa = trial_idx, trial_pat, dfa
+    if cur_dfa is not None:
+        groups.append((cur_idx, cur_dfa))
+
+    if not groups:
+        return RulePack(n_groups=0,
+                        class_maps=np.zeros((0, 256), np.int32),
+                        trans=np.zeros((0, 1, 1), np.int32),
+                        accept=np.zeros((0, 1), np.uint32),
+                        group_rules=[], fallback_rules=fallback,
+                        rule_ids=rule_ids, max_window=0)
+
+    s_max = max(d.n_states for _, d in groups)
+    c_max = max(d.n_classes for _, d in groups)
+    G = len(groups)
+    class_maps = np.zeros((G, 256), np.int32)
+    trans = np.zeros((G, s_max, c_max), np.int32)
+    accept = np.zeros((G, s_max), np.uint32)
+    group_rules = []
+    for g, (idxs, d) in enumerate(groups):
+        class_maps[g] = d.class_map
+        trans[g, :d.n_states, :d.n_classes] = d.trans
+        # pad classes: unseen classes can't occur (class_map covers 256
+        # bytes), pad states unreachable — zeros are fine.
+        accept[g, :d.n_states] = d.accept
+        group_rules.append(idxs)
+
+    return RulePack(n_groups=G, class_maps=class_maps, trans=trans,
+                    accept=accept, group_rules=group_rules,
+                    fallback_rules=fallback, rule_ids=rule_ids,
+                    s_max=s_max, c_max=c_max, max_window=max_window)
+
+
+def _pack_cache_key(rules) -> str:
+    h = hashlib.sha256()
+    h.update(f"v3|{GROUP_RULE_CAP}|{GROUP_STATE_CAP}|"
+             f"{GROUP_CLASS_CAP}|{WINDOW_CAP}|{REP_CAP}".encode())
+    for r in rules:
+        h.update(r.id.encode())
+        h.update(b"\x00")
+        h.update((r.regex.pattern if r.regex is not None else "").encode())
+        h.update(b"\x01")
+    return h.hexdigest()[:24]
+
+
+def load_or_compile(rules: list, cache_dir: Optional[str] = None)\
+        -> RulePack:
+    """Disk-cached compile: subset construction over 83 rules costs ~15s
+    of host time, so packs persist under the cache dir keyed by rule-set
+    hash (analog of the reference's analyzer-version cache keys)."""
+    import json
+    import os
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")), "trivy_tpu")
+    key = _pack_cache_key(rules)
+    path = os.path.join(cache_dir, f"rulepack_{key}.npz")
+    if os.path.exists(path):
+        try:
+            z = np.load(path, allow_pickle=False)
+            meta = json.loads(str(z["meta"]))
+            return RulePack(
+                n_groups=int(meta["n_groups"]),
+                class_maps=z["class_maps"], trans=z["trans"],
+                accept=z["accept"], group_rules=meta["group_rules"],
+                fallback_rules=meta["fallback_rules"],
+                rule_ids=meta["rule_ids"], s_max=int(meta["s_max"]),
+                c_max=int(meta["c_max"]),
+                max_window=int(meta["max_window"]))
+        except Exception:
+            pass  # stale/corrupt cache → recompile
+    pack = compile_rules(rules)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        meta = json.dumps({
+            "n_groups": pack.n_groups, "group_rules": pack.group_rules,
+            "fallback_rules": pack.fallback_rules,
+            "rule_ids": pack.rule_ids, "s_max": pack.s_max,
+            "c_max": pack.c_max, "max_window": pack.max_window})
+        np.savez_compressed(path, class_maps=pack.class_maps,
+                            trans=pack.trans, accept=pack.accept,
+                            meta=np.asarray(meta))
+    except OSError:
+        pass
+    return pack

@@ -109,8 +109,9 @@ class Scanner:
             return Secret(file_path=file_path)
 
         # Match offsets must index the original bytes for censoring; decode
-        # with surrogateescape so offsets map 1:1 for ASCII-compatible data.
+        # with surrogateescape so the text round-trips byte-identically.
         text = content.decode("utf-8", "surrogateescape")
+        to_bytes = _offset_converter(text, content)
         lowered = content.lower()
         global_blocks = _Blocks(content, self.exclude_block.regexes)
 
@@ -130,30 +131,33 @@ class Scanner:
             for loc in locs:
                 if global_blocks.match(loc) or local_blocks.match(loc):
                     continue
-                matched.append(_Match(rule, loc))
+                bloc = Location(to_bytes(loc.start), to_bytes(loc.end))
+                matched.append(_Match(rule, bloc))
                 if censored is None:
-                    censored = bytearray(text.encode("utf-8",
-                                                     "surrogateescape"))
-                bs = len(text[:loc.start].encode("utf-8", "surrogateescape"))
-                be = len(text[:loc.end].encode("utf-8", "surrogateescape"))
-                censored[bs:be] = b"*" * (be - bs)
+                    censored = bytearray(content)
+                censored[bloc.start:bloc.end] = \
+                    b"*" * (bloc.end - bloc.start)
 
         if not matched:
             return Secret()
 
         rendered = bytes(censored) if censored is not None else content
         findings = [
-            _to_finding(m.rule, _byte_loc(text, m.loc), rendered)
-            for m in matched
+            _to_finding(m.rule, m.loc, rendered) for m in matched
         ]
         findings.sort(key=lambda f: (f.rule_id, f.match))
         return Secret(file_path=file_path, findings=findings)
 
 
-def _byte_loc(text: str, loc: Location) -> Location:
-    bs = len(text[:loc.start].encode("utf-8", "surrogateescape"))
-    be = len(text[:loc.end].encode("utf-8", "surrogateescape"))
-    return Location(bs, be)
+def _offset_converter(text: str, content: bytes):
+    """char offset → byte offset. Identity for the (overwhelmingly
+    common) case where every char encodes one byte."""
+    if len(text) == len(content):
+        return lambda i: i
+
+    def conv(i: int) -> int:
+        return len(text[:i].encode("utf-8", "surrogateescape"))
+    return conv
 
 
 def _to_finding(rule: Rule, loc: Location, content: bytes) -> SecretFinding:
