@@ -4,23 +4,29 @@ Mirrors the reference's localhost-server trick for multi-node testing
 (SURVEY.md §4): a CPU backend with 8 fake devices stands in for a v5e-8
 TPU mesh so sharding/collective code paths compile and run in CI.
 
-Must run before the first `import jax` anywhere in the test session.
+jax may already be imported at interpreter startup (axon sitecustomize
+registers the TPU plugin), so env vars alone are too late —
+``jax.config.update`` is the authoritative override, applied before any
+backend-initializing call.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def mesh8():
-    import jax
     from trivy_tpu.parallel.mesh import make_mesh
     assert len(jax.devices()) >= 8
     return make_mesh(8)

@@ -1,0 +1,198 @@
+"""Unit tests for the anchor analysis + literal sieve kernel."""
+
+import re
+
+import numpy as np
+import pytest
+
+from trivy_tpu.secret.rx.anchor import (analyze_rule, anchor_literals,
+                                        max_match_len, strip_elastic)
+from trivy_tpu.secret.rx.parser import parse
+
+
+def _lits(pattern):
+    return anchor_literals(strip_elastic(parse(pattern))[0])
+
+
+class TestMaxMatchLen:
+    def test_bounded(self):
+        assert max_match_len(parse(r"abc")) == 3
+        assert max_match_len(parse(r"a{2,5}b?")) == 6
+        assert max_match_len(parse(r"(ab|cde)")) == 3
+
+    def test_unbounded(self):
+        assert max_match_len(parse(r"a+b")) == float("inf")
+        assert max_match_len(parse(r"a*")) == float("inf")
+
+
+class TestAnchors:
+    def test_simple_literal(self):
+        assert _lits(r"ghp_[0-9a-zA-Z]{36}") == [b"ghp_"]
+
+    def test_alt_of_literals(self):
+        assert _lits(r"pk_(test|live)_[0-9a-z]{10}") == \
+            [b"pk_live_", b"pk_test_"]
+
+    def test_case_folding(self):
+        assert _lits(r"(?i)GLPAT-[0-9a-z]{20}") == [b"glpat-"]
+
+    def test_alt_requires_all_branches(self):
+        # one unanchorable branch → no anchor set
+        assert _lits(r"(ghp_x+|[0-9]{20})[a-z]") is None
+
+    def test_short_run_rescued_by_class(self):
+        lits = _lits(r"SK[0-9a-f]{32}")
+        assert lits is not None and all(len(x) == 3 for x in lits)
+        assert b"sk0" in lits and b"skf" in lits
+
+    def test_zero_width_transparent(self):
+        assert _lits(r"\bAKIA\b") == [b"akia"]
+
+
+class TestElastic:
+    def test_strip_prefix_suffix(self):
+        ra = analyze_rule(r'(^|\s+)tok_[0-9]{8}(\s+|$)')
+        assert ra.anchored and ra.literals == [b"tok_"]
+        # core 12 + UTF-8-safe elastic slack per stripped edge + 2
+        assert ra.window == 12 + 11 + 11 + 2
+
+    def test_long_min_edge_run_widens_window(self):
+        # regression: a \s{30,} guard needs 30 visible spaces in the
+        # prelim window or the rule is silently dropped
+        ra = analyze_rule(r"\s{30,}tok_[0-9]{8}")
+        assert ra.anchored
+        assert ra.window >= 12 + 30
+
+    def test_multibyte_wildcard_counts_four_bytes(self):
+        # regression: '.' can consume a 4-byte UTF-8 char; window math
+        # is in bytes
+        ra = analyze_rule(r"drop_.{0,5}key[0-9]{4}")
+        assert ra.anchored
+        assert ra.window >= 5 + 4 * 5 + 3 + 4
+
+    def test_unicode_shorthand_counts_four_bytes(self):
+        # regression: \s matches U+2028 (3 UTF-8 bytes) in str regexes
+        ra = analyze_rule(r"tok_[0-9]{4}\s{0,8}END[0-9]{4}")
+        assert ra.anchored
+        assert ra.window >= 8 + 4 * 8 + 7
+
+    def test_non_ascii_literal_rejected(self):
+        import pytest as _pt
+        from trivy_tpu.secret.rx.parser import RegexParseError
+        with _pt.raises(RegexParseError):
+            parse("€tok[0-9]{6}")
+        with _pt.raises(RegexParseError):
+            parse("[é-ü]x")
+        # and the rule pack routes such rules to host fallback
+        from trivy_tpu.secret.model import Rule, compile_rx
+        from trivy_tpu.secret.rx.pack import compile_rules
+        pack = compile_rules([Rule(id="euro",
+                                   regex=compile_rx("€tok[0-9]{6}"))])
+        assert pack.fallback_rules == [0]
+
+    def test_interior_space_not_elastic(self):
+        ra = analyze_rule(r"key\s*=\s*[0-9]{4}")
+        assert not ra.anchored
+
+    def test_unbounded_not_anchored(self):
+        ra = analyze_rule(r"-----BEGIN x+ KEY-----")
+        assert not ra.anchored
+
+
+class TestWindowSoundness:
+    """Randomized check of the windowed-verify soundness claim: if the
+    full text matches, a window around an anchor hit matches too."""
+
+    @pytest.mark.parametrize("pattern,sample", [
+        (r'(^|\s+)["\']?tok_(?P<secret>[0-9a-z]{12})["\']?(\s+|$)',
+         b"   tok_abc123def456 "),
+        (r"ghp_[0-9a-zA-Z]{36}", b"ghp_" + b"q" * 36),
+    ])
+    def test_window_finds_match(self, pattern, sample):
+        ra = analyze_rule(pattern)
+        assert ra.anchored
+        rx = re.compile(pattern.encode())
+        rng = np.random.default_rng(0)
+        for trial in range(20):
+            pad_l = b" " * int(rng.integers(0, 30)) + b"x" * 40
+            pad_r = b"y" * 40 + b" " * int(rng.integers(0, 30))
+            text = pad_l + sample + pad_r
+            m = rx.search(text)
+            assert m is not None
+            # locate anchor hit, build the window as batch.py does
+            low = text.lower()
+            hits = [low.find(a) for a in ra.literals if a in low]
+            assert hits, "anchor must occur inside the match"
+            p = min(h for h in hits if h >= 0)
+            w = ra.window + 8
+            a, b = max(0, p - w), min(len(text), p + 128 + w)
+            assert rx.search(text[a:b]) is not None
+
+
+class TestKernel:
+    def test_blockmask_host_vs_jax(self):
+        import jax.numpy as jnp
+        from trivy_tpu.ops.keywords import (_pad_codes,
+                                            build_code_table,
+                                            code_blockmask,
+                                            code_blockmask_host)
+        t = build_code_table(
+            [b"akia", b"ghp_", b"hooks.sl", b"xoxb-", b"key"])
+        codes = _pad_codes((t.lo, t.hi, t.lo_mask, t.hi_mask))
+        rng = np.random.default_rng(1)
+        buf = rng.integers(32, 127, (19, 256)).astype(np.uint8)
+        buf[3, 10:14] = np.frombuffer(b"AKIA", np.uint8)
+        buf[7, 250:254] = np.frombuffer(b"ghp_", np.uint8)   # tail edge
+        buf[11, 100:103] = np.frombuffer(b"KeY", np.uint8)
+        got = np.asarray(code_blockmask(
+            jnp.asarray(buf), *(jnp.asarray(c) for c in codes)))
+        want = code_blockmask_host(buf, *codes)
+        np.testing.assert_array_equal(got, want)
+        k_akia = t.index(b"akia")
+        assert want[3, k_akia] & 0b1          # block 0 (pos 10 < 16)
+        assert want[11, t.index(b"key")]      # case-folded
+
+    def test_pallas_kernel_interpret_parity(self):
+        import jax.numpy as jnp
+        from trivy_tpu.ops.keywords import (_pad_codes,
+                                            build_code_table,
+                                            code_blockmask_host)
+        from trivy_tpu.ops.keywords_pallas import code_blockmask_pallas
+        t = build_code_table(
+            [b"akia", b"ghp_", b"hooks.sl", b"xoxb-", b"sk"])
+        codes = _pad_codes((t.lo, t.hi, t.lo_mask, t.hi_mask))
+        rng = np.random.default_rng(2)
+        buf = rng.integers(32, 127, (128, 2048)).astype(np.uint8)
+        buf[3, 10:14] = np.frombuffer(b"AKIA", np.uint8)
+        buf[9, 2030:2034] = np.frombuffer(b"GHP_", np.uint8)
+        got = np.asarray(code_blockmask_pallas(
+            jnp.asarray(buf), *(jnp.asarray(c) for c in codes),
+            interpret=True))
+        want = code_blockmask_host(buf, *codes)
+        np.testing.assert_array_equal(got, want)
+        assert want[3].any() and want[9].any()
+
+    def test_code_table_dedup_and_prefix(self):
+        from trivy_tpu.ops.keywords import build_code_table
+        t = build_code_table([b"verylongkeyword", b"verylong",
+                              b"AKIA", b"akia"])
+        assert t.n_codes == 2
+        assert t.index(b"verylongkeyword") == t.index(b"verylong")
+
+
+class TestPlan:
+    def test_builtin_plan_shape(self):
+        from trivy_tpu.secret.plan import build_scan_plan
+        from trivy_tpu.secret.scanner import new_scanner
+        s = new_scanner()
+        plan = build_scan_plan(s.rules)
+        assert len(plan.rules) == len(s.rules)
+        anchored = [rp for rp in plan.rules if rp.anchored]
+        assert len(anchored) >= 75
+        ids = {s.rules[rp.rule_index].id for rp in plan.rules
+               if not rp.anchored}
+        assert "private-key" in ids
+        # every rule with keywords has gate codes
+        for rp in plan.rules:
+            if s.rules[rp.rule_index].keywords:
+                assert rp.gate

@@ -14,17 +14,15 @@ plumbing.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-@functools.partial(jax.jit, donate_argnums=())
-def dfa_hits(segments: jax.Array, class_maps: jax.Array,
-             trans: jax.Array, accept: jax.Array) -> jax.Array:
-    """Run every group DFA over every segment.
+def dfa_hits_impl(segments: jax.Array, class_maps: jax.Array,
+                  trans: jax.Array, accept: jax.Array) -> jax.Array:
+    """Run every group DFA over every segment (traceable, un-jitted —
+    usable inside shard_map; see trivy_tpu.parallel.secret_shard).
 
     Args:
       segments:   [B, L] uint8 padded byte buffer (pad value irrelevant —
@@ -58,6 +56,9 @@ def dfa_hits(segments: jax.Array, class_maps: jax.Array,
 
     hits = jax.vmap(per_group)(class_maps, trans, accept)   # [G, B]
     return hits.T
+
+
+dfa_hits = jax.jit(dfa_hits_impl)
 
 
 def dfa_hits_host(segments, class_maps, trans, accept):

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the literal blockmask sieve.
+
+The XLA `lax.scan` formulation of trivy_tpu.ops.keywords re-reads the
+[B, L] window-word arrays from HBM on every code chunk (~26 ms/chunk
+measured). This kernel reads each segment tile ONCE into VMEM, builds
+the sliding-window words in registers, then loops all K codes over the
+resident tile — HBM traffic drops from K/8 × 2×4L×B to 1 × L×B bytes.
+
+Layout:
+  grid           = (B // TILE_B,)
+  segments block = [TILE_B, L] uint8 in VMEM
+  codes          = 4 × [Kp] uint32, scalar-prefetched to SMEM
+  out block      = [TILE_B, Kp] uint32 — masks for 128 codes at a time
+                   accumulate in registers via lane-select (dynamic
+                   lane stores must be 128-aligned), one store per
+                   128-code group
+
+Out bit j of word [k, b] = code k matched somewhere in 128-byte block j
+of segment b (N_BLOCKS = 16 blocks over L = 2048).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .keywords import N_BLOCKS
+
+TILE_B = 128
+
+
+def _kernel(lo_ref, hi_ref, lom_ref, him_ref, seg_ref, out_ref):
+    x = seg_ref[:].astype(jnp.uint32)                    # [bT, L]
+    bT, L = x.shape
+    is_upper = (x >= 65) & (x <= 90)
+    x = jnp.where(is_upper, x + 32, x)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, (bT, L), 1)
+
+    def shifted(i):
+        if i == 0:
+            return x
+        r = pltpu.roll(x, L - i, 1)    # circular left-shift by i
+        return jnp.where(col < L - i, r, jnp.uint32(0))
+
+    lo = (shifted(0) | (shifted(1) << 8) | (shifted(2) << 16)
+          | (shifted(3) << 24))
+    hi = (shifted(4) | (shifted(5) << 8) | (shifted(6) << 16)
+          | (shifted(7) << 24))
+
+    K = out_ref.shape[1]
+    blk = L // N_BLOCKS
+
+    # block-membership indicator: position p belongs to block p // blk.
+    # The per-code block reduction rides the MXU as [bT,L] @ [L,16]
+    # (hit counts are exact in f32: ≤ blk = 128 ones per block).
+    pos_blk = jax.lax.broadcasted_iota(jnp.int32, (L, N_BLOCKS), 0) \
+        // blk
+    blk_id = jax.lax.broadcasted_iota(jnp.int32, (L, N_BLOCKS), 1)
+    ind = (pos_blk == blk_id).astype(jnp.float32)         # [L, 16]
+    bit_val = (jnp.int32(1) << jax.lax.broadcasted_iota(
+        jnp.int32, (bT, N_BLOCKS), 1))
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bT, 128), 1)
+
+    # dynamic-lane stores must be 128-aligned on TPU, so masks for 128
+    # codes accumulate in registers (lane-select) and store as one tile
+    for g in range(K // 128):
+        def body(j, acc, g=g):
+            k = g * 128 + j
+            klo = lo_ref[k]
+            khi = hi_ref[k]
+            mlo = lom_ref[k]
+            mhi = him_ref[k]
+            hit = ((lo & mlo) == klo) & ((hi & mhi) == khi)  # [bT, L]
+            counts = jnp.dot(hit.astype(jnp.float32), ind,
+                             preferred_element_type=jnp.float32)
+            mask = jnp.sum(jnp.where(counts > 0, bit_val, 0),
+                           axis=1, keepdims=True)            # [bT, 1]
+            return jnp.where(lane == j, mask, acc)
+
+        acc = jax.lax.fori_loop(
+            0, 128, body, jnp.zeros((bT, 128), jnp.int32))
+        out_ref[:, g * 128:(g + 1) * 128] = acc.astype(jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def code_blockmask_pallas(segments: jax.Array, lo_c: jax.Array,
+                          hi_c: jax.Array, lo_m: jax.Array,
+                          hi_m: jax.Array,
+                          interpret: bool = False) -> jax.Array:
+    """[B, L] uint8 × K codes → [B, K] uint32 blockmasks.
+
+    B must be a multiple of TILE_B and L a multiple of N_BLOCKS×128
+    (callers bucket-pad — ops.keywords.pad_batch)."""
+    B, L = segments.shape
+    K0 = lo_c.shape[0]
+    assert B % TILE_B == 0 and L % 128 == 0
+
+    K = ((K0 + 127) // 128) * 128
+    if K != K0:
+        pad = K - K0
+        z = jnp.zeros(pad, jnp.uint32)
+        f = jnp.full(pad, 0xFFFFFFFF, jnp.uint32)
+        lo_c = jnp.concatenate([lo_c.astype(jnp.uint32), z])
+        hi_c = jnp.concatenate([hi_c.astype(jnp.uint32), z])
+        lo_m = jnp.concatenate([lo_m.astype(jnp.uint32), f])
+        hi_m = jnp.concatenate([hi_m.astype(jnp.uint32), f])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B // TILE_B,),
+        in_specs=[
+            pl.BlockSpec((TILE_B, L), lambda i, *_: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE_B, K), lambda i, *_: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((B, K), jnp.uint32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(lo_c.astype(jnp.uint32), hi_c.astype(jnp.uint32),
+      lo_m.astype(jnp.uint32), hi_m.astype(jnp.uint32), segments)
+    return out[:, :K0]

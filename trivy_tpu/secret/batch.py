@@ -1,20 +1,21 @@
-"""Batched secret scanning: TPU hit-detection + sparse host verification.
+"""Batched secret scanning: TPU literal sieve + windowed host verify.
 
 Pipeline (the TPU re-design of the reference's per-file scan loop,
 pkg/fanal/secret/scanner.go:341):
 
   1. files → fixed-size overlapping segments in one [B, L] uint8 buffer
      (the "sequence dimension" of this domain — SURVEY.md §5);
-  2. one kernel dispatch advances every rule-group DFA over every
-     segment (trivy_tpu.ops.dfa);
-  3. hit (segment, group, bit) triples decode to (file, rule)
-     candidates; host re-runs the CPU-exact engine per candidate file
-     restricted to its candidate rules — byte-identical findings,
-     because rules with no DFA hit can contribute neither findings nor
-     censoring.
-
-Fallback rules (host-only DFAs, e.g. private-key) are appended to every
-file's candidate set, pre-gated by their keyword prefilter.
+  2. ONE kernel dispatch matches every gate keyword + anchor literal
+     over every segment (trivy_tpu.ops.keywords), returning per-segment
+     position bitmasks — pure elementwise work, no gathers;
+  3. host decodes hits: a rule is *gated in* for a file iff one of its
+     keywords hit (reference MatchKeywords semantics); for rules whose
+     regex is provably anchor-bounded (rx.anchor), a preliminary regex
+     over small windows around anchor hits decides whether the rule can
+     match at all;
+  4. files with surviving rules get a CPU-exact scan restricted to
+     those rules — byte-identical findings, because every rule that
+     could contribute findings (or censoring) survives the sieve.
 """
 
 from __future__ import annotations
@@ -24,15 +25,15 @@ from typing import Iterable, Optional
 
 import numpy as np
 
+from ..ops.keywords import MAX_CODE_LEN, N_BLOCKS, run_blockmask
 from ..utils import get_logger
-from .model import Rule
-from .rx import RulePack, load_or_compile
+from .plan import ScanPlan, build_scan_plan
 from .scanner import Scanner
 
 log = get_logger("secret.batch")
 
-SEG_LEN = 2048      # segment length in bytes
-MIN_OVERLAP = 192   # must be ≥ pack.max_window (asserted)
+SEG_LEN = 2048       # segment length in bytes
+OVERLAP = 16         # ≥ MAX_CODE_LEN so no literal straddles uncovered
 
 
 @dataclass
@@ -47,22 +48,27 @@ class BatchSecretScanner:
     but over a batch; results are CPU-engine-identical."""
 
     def __init__(self, scanner: Optional[Scanner] = None,
-                 seg_len: int = SEG_LEN, backend: str = "tpu"):
+                 seg_len: int = SEG_LEN, backend: str = "tpu",
+                 mesh=None):
         if scanner is None:
             from .scanner import new_scanner
             scanner = new_scanner()
         self.scanner = scanner
         self.backend = backend
-        self.pack: RulePack = load_or_compile(self.scanner.rules)
-        self.overlap = max(MIN_OVERLAP, self.pack.max_window)
-        self.seg_len = max(seg_len, 2 * self.overlap)
-        self._jax_tables = None
+        self.mesh = mesh
+        self.overlap = max(OVERLAP, MAX_CODE_LEN)
+        # kernels need L % 128 == 0 (lane width / block reduction)
+        self.seg_len = max(seg_len, 4 * self.overlap, 128)
+        self.seg_len = ((self.seg_len + 127) // 128) * 128
+        self.plan: ScanPlan = build_scan_plan(self.scanner.rules)
 
     # --- segmenting ---
 
     def _segment(self, files: list) -> tuple:
-        """Flatten files into [B, L] uint8 with per-file overlap chaining."""
+        """Flatten files into [B, L] uint8 with per-file overlap
+        chaining. Returns (buffer, seg_file, seg_pos)."""
         seg_file: list = []
+        seg_pos: list = []
         chunks: list = []
         step = self.seg_len - self.overlap
         for fe in files:
@@ -71,43 +77,18 @@ class BatchSecretScanner:
                 continue
             pos = 0
             while True:
-                chunk = fe.content[pos:pos + self.seg_len]
-                chunks.append(chunk)
+                chunks.append(fe.content[pos:pos + self.seg_len])
                 seg_file.append(fe.index)
+                seg_pos.append(pos)
                 if pos + self.seg_len >= n:
                     break
                 pos += step
         if not chunks:
-            return np.zeros((0, self.seg_len), np.uint8), []
-        B = len(chunks)
-        buf = np.zeros((B, self.seg_len), np.uint8)
+            return (np.zeros((0, self.seg_len), np.uint8), [], [])
+        buf = np.zeros((len(chunks), self.seg_len), np.uint8)
         for i, c in enumerate(chunks):
             buf[i, :len(c)] = np.frombuffer(c, np.uint8)
-        return buf, seg_file
-
-    # --- kernel dispatch ---
-
-    def _tables(self):
-        if self._jax_tables is None:
-            import jax.numpy as jnp
-            p = self.pack
-            self._jax_tables = (jnp.asarray(p.class_maps),
-                                jnp.asarray(p.trans),
-                                jnp.asarray(p.accept))
-        return self._jax_tables
-
-    def _kernel_hits(self, buf: np.ndarray) -> np.ndarray:
-        """[B, L] → [B, G] uint32 hit masks."""
-        if self.pack.n_groups == 0 or buf.shape[0] == 0:
-            return np.zeros((buf.shape[0], 0), np.uint32)
-        if self.backend == "cpu-ref":
-            from ..ops.dfa import dfa_hits_host
-            p = self.pack
-            return dfa_hits_host(buf, p.class_maps, p.trans, p.accept)
-        import jax.numpy as jnp
-        from ..ops.dfa import dfa_hits
-        cmaps, trans, accept = self._tables()
-        return np.asarray(dfa_hits(jnp.asarray(buf), cmaps, trans, accept))
+        return buf, seg_file, seg_pos
 
     # --- the public API ---
 
@@ -133,26 +114,82 @@ class BatchSecretScanner:
                 results.append(secret)
         return results
 
+    # --- sieve stages ---
+
     def _candidates(self, entries: list) -> dict:
-        """file index → set of candidate rule indices."""
-        candidates: dict = {}
+        """file index → set of rule indices that must be scanned
+        exactly."""
+        buf, seg_file, seg_pos = self._segment(entries)
+        if buf.shape[0] == 0:
+            return {}
+        masks = run_blockmask(buf, self.plan.table,
+                              backend=self.backend, mesh=self.mesh)
 
-        buf, seg_file = self._segment(entries)
-        if buf.shape[0]:
-            hits = self._kernel_hits(buf)
-            nonzero = np.nonzero(hits.any(axis=1))[0]
-            for si in nonzero:
-                fidx = seg_file[si]
-                rids = self.pack.decode_hits(hits[si])
-                if rids:
-                    candidates.setdefault(fidx, set()).update(rids)
+        # per file: code → merged list of (segment file-offset, bitmask)
+        file_codes: dict = {}
+        seg_nz, code_nz = np.nonzero(masks)
+        for si, ci in zip(seg_nz.tolist(), code_nz.tolist()):
+            fc = file_codes.setdefault(seg_file[si], {})
+            fc.setdefault(ci, []).append((seg_pos[si], int(masks[si, ci])))
 
-        # Host-fallback rules: keyword-gated exact scan per file.
-        if self.pack.fallback_rules:
+        by_index = {fe.index: fe for fe in entries}
+        blk = self.seg_len // N_BLOCKS
+        out: dict = {}
+
+        # rules with no keyword gate and no anchor run everywhere
+        # (reference: empty keyword list passes MatchKeywords)
+        always = [rp.rule_index for rp in self.plan.rules
+                  if not rp.gate and not rp.anchored]
+        if always:
             for fe in entries:
-                lowered = fe.content.lower()
-                for ri in self.pack.fallback_rules:
-                    rule = self.scanner.rules[ri]
-                    if rule.match_keywords(lowered):
-                        candidates.setdefault(fe.index, set()).add(ri)
-        return candidates
+                out[fe.index] = set(always)
+
+        for fidx, codes in file_codes.items():
+            fe = by_index[fidx]
+            hit = set(codes)
+            chosen = set(out.get(fidx, ()))
+            for rp in self.plan.rules:
+                if rp.gate and not (hit & rp.gate):
+                    continue
+                if not rp.anchored:
+                    chosen.add(rp.rule_index)
+                    continue
+                anchor_hits = [h for a in rp.anchors
+                               for h in codes.get(a, ())]
+                if not anchor_hits:
+                    continue
+                if self._prelim(fe, rp, anchor_hits, blk):
+                    chosen.add(rp.rule_index)
+            if chosen:
+                out[fidx] = chosen
+        return out
+
+    def _prelim(self, fe: _FileEntry, rp, anchor_hits: list,
+                blk: int) -> bool:
+        """Windowed existence check around anchor hit blocks."""
+        rule = self.scanner.rules[rp.rule_index]
+        w = rp.window + MAX_CODE_LEN
+        spans = []
+        for pos, mask in anchor_hits:
+            m = mask
+            while m:
+                lsb = m & -m
+                j = lsb.bit_length() - 1
+                m ^= lsb
+                a = pos + j * blk - w
+                b = pos + (j + 1) * blk + w
+                spans.append((max(0, a), min(len(fe.content), b)))
+        spans.sort()
+        merged = []
+        for a, b in spans:
+            if merged and a <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], b))
+            else:
+                merged.append((a, b))
+        for a, b in merged:
+            # decode mirrors Scanner.scan; edge-partial codepoints sit
+            # in the ≥8-byte margin outside any possible match span
+            window = fe.content[a:b].decode("utf-8", "surrogateescape")
+            if rule.regex.search(window):
+                return True
+        return False

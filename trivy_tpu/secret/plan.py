@@ -1,0 +1,67 @@
+"""Per-rule scan plan: gate codes + anchor windows.
+
+Built once per rule set; consumed by BatchSecretScanner. For each rule:
+
+  - ``gate``: code indices for the rule's keywords (first 8 bytes,
+    lowercased) — the rule is considered for a file iff any gate code
+    hits any of the file's segments (superset of the reference's
+    MatchKeywords substring gate; the host exact scan re-applies the
+    full-keyword check). Rules without keywords always pass
+    (scanner.go:164-168 returns true on an empty keyword list).
+  - ``anchors`` + ``window``: when rx.anchor proves every match
+    contains one of the anchor literals within a bounded span, the
+    host only needs to regex windows around anchor hits. Otherwise the
+    rule is scanned whole-file whenever its gate passes (reference
+    behavior).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..ops.keywords import CodeTable, build_code_table
+from .rx.anchor import analyze_rule
+
+
+@dataclass
+class RulePlan:
+    rule_index: int
+    gate: frozenset               # code indices; empty = always pass
+    anchored: bool = False
+    anchors: list = field(default_factory=list)   # code indices
+    window: int = 0               # bytes each side of an anchor hit
+
+
+@dataclass
+class ScanPlan:
+    table: CodeTable
+    rules: list                   # list[RulePlan], same order as input
+
+
+def build_scan_plan(rules) -> ScanPlan:
+    """``rules``: sequence of secret.model.Rule."""
+    analyses = []
+    literals: list = []
+    for r in rules:
+        kws = [k.lower().encode() for k in r.keywords if k]
+        ra = analyze_rule(r.regex.pattern) if r.regex is not None \
+            else None
+        if ra is not None and not ra.anchored:
+            ra = None
+        analyses.append((kws, ra))
+        literals.extend(kws)
+        if ra is not None:
+            literals.extend(ra.literals)
+
+    table = build_code_table(literals)
+    plans = []
+    for i, (kws, ra) in enumerate(analyses):
+        rp = RulePlan(rule_index=i,
+                      gate=frozenset(table.index(k) for k in kws))
+        if ra is not None:
+            rp.anchored = True
+            rp.anchors = sorted({table.index(a) for a in ra.literals})
+            rp.window = ra.window
+        plans.append(rp)
+    return ScanPlan(table=table, rules=plans)

@@ -1,0 +1,345 @@
+"""Anchor-literal extraction: the static analysis behind windowed verify.
+
+For each rule regex we try to prove: *every* match contains one of a
+small set of literal byte-strings (the rule's "anchors"). When that
+holds and the match length is bounded, the TPU keyword kernel's hit
+positions for those literals bound every possible match location — the
+host then only has to regex small windows around hits instead of whole
+files. Rules where the proof fails (unbounded matches, alternation too
+wide) fall back to reference behavior: whole-file regex whenever the
+rule's keyword gate passes (pkg/fanal/secret/scanner.go:341-417 runs
+the regex over full content after MatchKeywords).
+
+Soundness: ``anchor_literals`` returns S only if every string matched
+by the (case-folded) regex contains ≥1 element of S as a substring;
+``max_match_len`` returns a finite M only if no match exceeds M bytes.
+Both are proved compositionally over the parsed AST.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .parser import Alt, Boundary, Cat, Empty, Lit, Rep, parse
+
+INF = float("inf")
+
+MAX_ANCHOR_SET = 64       # most alternatives a candidate set may hold
+MAX_PRODUCT = 40          # give up productizing classes past this
+MIN_ANCHOR_LEN = 3        # anchors shorter than this match too often
+MAX_ANCHOR_LEN = 8        # keyword-kernel code width
+MAX_CLASS_FANOUT = 16     # productize byte classes up to this size
+
+
+def max_match_len(node) -> float:
+    """Upper bound on the BYTE length of any match. INF if unbounded.
+
+    The AST is parsed over bytes, but rule regexes run on decoded
+    text (Scanner.scan) where one pattern unit like ``.`` consumes one
+    *character* — up to 4 UTF-8 bytes. A Lit whose class can reach
+    non-ASCII therefore counts 4 bytes, keeping byte-sliced windows
+    sound for matches containing multibyte characters."""
+    if isinstance(node, (Boundary, Empty)):
+        return 0
+    if isinstance(node, Lit):
+        return 1 if (node.ascii_only
+                     and all(b < 0x80 for b in node.bytes)) else 4
+    if isinstance(node, Cat):
+        return sum(max_match_len(p) for p in node.parts)
+    if isinstance(node, Alt):
+        return max(max_match_len(o) for o in node.options)
+    if isinstance(node, Rep):
+        if node.max is None:
+            inner = max_match_len(node.node)
+            return 0 if inner == 0 else INF
+        return node.max * max_match_len(node.node)
+    raise TypeError(node)
+
+
+_SPACE = frozenset(b" \t\n\r\f\v")
+
+
+def _is_space_run(node) -> bool:
+    return (isinstance(node, Rep) and node.max is None
+            and isinstance(node.node, Lit)
+            and node.node.bytes <= _SPACE)
+
+
+def _is_edge_boundary(node, kind: str) -> bool:
+    if isinstance(node, Boundary):
+        return node.kind == kind
+    if isinstance(node, Cat):
+        return len(node.parts) == 1 and _is_edge_boundary(
+            node.parts[0], kind)
+    return False
+
+
+def _elastic_edge(node, kind: str) -> bool:
+    """True if ``node`` is an *edge-elastic* context guard: an unbounded
+    pure-whitespace run, optionally alternated with the matching anchor
+    (``^`` for prefix, ``$`` for suffix) or ε.
+
+    Soundness of dropping it from the window bound: any window slice
+    that truncates the whitespace run still matches — a sub-run of
+    whitespace is whitespace, and the ``^``/``$``/ε alternative (or
+    ``min=0``) covers the cut landing exactly at the core edge. A
+    windowed ``re.search`` therefore finds a (possibly shorter) match
+    whenever the full text had one. False positives are fine — every
+    prelim hit is re-verified by a whole-file exact scan.
+    """
+    if _is_space_run(node):
+        # bare run: sound with window slack ≥2 — the slice always
+        # retains ≥1 run byte (or the run was empty and min==0)
+        return True
+    if isinstance(node, Alt):
+        has_edge = any(
+            _is_edge_boundary(o, kind) or isinstance(o, Empty)
+            for o in node.options)
+        runs_ok = all(
+            _is_space_run(o) or _is_edge_boundary(o, kind)
+            or isinstance(o, Empty)
+            for o in node.options)
+        return has_edge and runs_ok
+    return False
+
+
+def _edge_run_min(node) -> int:
+    """Window widening for a stripped elastic edge: the slice must
+    retain ``min`` COMPLETE whitespace characters for re.search to
+    succeed (``\\s{30,}`` needs 30 visible). ``\\s`` is Unicode-aware
+    (up to 4 bytes/char) and the slice cut can split one character,
+    hence 4·(min+1)+3 bytes rather than ``min``."""
+    m = 0
+    if _is_space_run(node):
+        m = node.min
+    elif isinstance(node, Alt):
+        m = max((o.min for o in node.options if _is_space_run(o)),
+                default=0)
+    return 4 * (m + 1) + 3
+
+
+def strip_elastic(node) -> tuple:
+    """Drop edge-elastic prefix/suffix guards from a top-level Cat;
+    returns ``(core, extra_window)`` — window math happens on the
+    core, widened by the stripped runs' minimum lengths."""
+    if not isinstance(node, Cat) or not node.parts:
+        return node, 0
+    parts = list(node.parts)
+    extra = 0
+    while parts and (_elastic_edge(parts[0], "^")
+                     or _is_space_run(parts[0])):
+        extra += _edge_run_min(parts.pop(0))
+    while parts and (_elastic_edge(parts[-1], "$")
+                     or _is_space_run(parts[-1])):
+        extra += _edge_run_min(parts.pop())
+    return (Cat(parts) if parts else Empty()), extra
+
+
+def _lower_byte(b: int) -> int:
+    return b + 32 if 65 <= b <= 90 else b
+
+
+def _class_lowered(bs: frozenset) -> frozenset:
+    return frozenset(_lower_byte(b) for b in bs)
+
+
+def _product(runs: list, cls: frozenset) -> Optional[list]:
+    """Extend every partial string by every byte of ``cls`` (lowered)."""
+    lowered = sorted(_class_lowered(cls))
+    if len(runs) * len(lowered) > MAX_PRODUCT:
+        return None
+    return [r + bytes([b]) for r in runs for b in lowered]
+
+
+_COMMON_LITERALS = {b"https://", b"http://", b"https:/", b"http:/",
+                    b"www."}
+
+
+@dataclass
+class _Cand:
+    """One candidate anchor set with a quality score."""
+
+    literals: list            # list[bytes], lowercased
+
+    @property
+    def min_len(self) -> int:
+        return min(len(x) for x in self.literals)
+
+    @property
+    def score(self) -> tuple:
+        # a set made only of ubiquitous literals would make every web
+        # page a candidate window — rank it below anything specific
+        common = all(x in _COMMON_LITERALS for x in self.literals)
+        # extra length raises specificity, but every literal is one
+        # more kernel pass — one distinctive 4-byte anchor beats a
+        # 36-way productized 5-byte set
+        return (not common,
+                min(self.min_len, 8) - 0.12 * len(self.literals))
+
+
+def _literal_strings(node) -> Optional[list]:
+    """All strings of L(node), lowercased — or None if not a small
+    finite literal language (used to push runs through alternations
+    like ``(test|live)``)."""
+    if isinstance(node, Empty) or (isinstance(node, Boundary)):
+        return [b""]
+    if isinstance(node, Lit):
+        # Unicode-aware units (\d, [^…], .) can match characters the
+        # byte product cannot enumerate — never productize them
+        if not node.ascii_only:
+            return None
+        lowered = sorted(_class_lowered(node.bytes))
+        if len(lowered) > MAX_CLASS_FANOUT:
+            return None
+        return [bytes([b]) for b in lowered]
+    if isinstance(node, Cat):
+        acc = [b""]
+        for p in node.parts:
+            sub = _literal_strings(p)
+            if sub is None or len(acc) * len(sub) > MAX_ANCHOR_SET:
+                return None
+            acc = [a + s for a in acc for s in sub]
+        return acc
+    if isinstance(node, Alt):
+        acc = []
+        for o in node.options:
+            sub = _literal_strings(o)
+            if sub is None:
+                return None
+            acc.extend(sub)
+            if len(acc) > MAX_ANCHOR_SET:
+                return None
+        return acc
+    if isinstance(node, Rep):
+        if node.max is None or node.min != node.max:
+            return None
+        sub = _literal_strings(node.node)
+        if sub is None:
+            return None
+        acc = [b""]
+        for _ in range(node.min):
+            if len(acc) * len(sub) > MAX_ANCHOR_SET:
+                return None
+            acc = [a + s for a in acc for s in sub]
+        return acc
+    return None
+
+
+def _cat_run_candidates(parts: list) -> list:
+    """Literal-run candidates inside a concatenation: consecutive
+    mandatory parts with small finite literal languages, productized.
+    A run flushes when a part is optional, unbounded, or fans out too
+    wide to productize."""
+    out: list = []
+    cur: list = [b""]
+
+    def flush():
+        nonlocal cur
+        if any(len(r) >= MIN_ANCHOR_LEN for r in cur):
+            lits = [r[:MAX_ANCHOR_LEN] for r in cur]
+            out.append(_Cand(sorted(set(lits))))
+        cur = [b""]
+
+    for p in parts:
+        if isinstance(p, (Boundary, Empty)):
+            continue                       # zero-width: run stays contiguous
+        strs = _literal_strings(p)
+        if strs is not None and all(len(s) > 0 for s in strs):
+            if all(len(r) < MAX_ANCHOR_LEN for r in cur):
+                if len(cur) * len(strs) <= MAX_ANCHOR_SET:
+                    cur = [r + s for r in cur for s in strs]
+                    continue
+            # run already saturated: keep it, start fresh with this part
+            flush()
+            if len(strs) <= MAX_ANCHOR_SET:
+                cur = list(strs)
+            continue
+        # a mandatory class repeat can rescue a run still below the
+        # usable length by contributing its first byte
+        # (SK[0-9a-f]{32} → "sk"+hexdigit) — never dilute longer runs
+        if (isinstance(p, Rep) and p.min >= 1
+                and isinstance(p.node, Lit) and p.node.ascii_only
+                and any(0 < len(r) < MIN_ANCHOR_LEN for r in cur)):
+            ext = _product(cur, p.node.bytes)
+            if ext is not None:
+                cur = ext
+        flush()
+    flush()
+    return [c for c in out if c.min_len >= MIN_ANCHOR_LEN]
+
+
+def anchor_literals(node) -> Optional[list]:
+    """Set S of lowercased literals such that every match contains some
+    s ∈ S — or None if no usable S is found."""
+    cand = _best_candidate(node)
+    return cand.literals if cand is not None else None
+
+
+def _best_candidate(node) -> Optional[_Cand]:
+    if isinstance(node, (Boundary, Empty, Lit)):
+        # single-byte anchors are below MIN_ANCHOR_LEN
+        if isinstance(node, Lit):
+            return None
+        return None
+    if isinstance(node, Cat):
+        cands = _cat_run_candidates(node.parts)
+        # recursing into composite parts can find better anchors
+        # (e.g. a Cat of [prefix-classes, Alt-of-literals, suffix])
+        for p in node.parts:
+            if isinstance(p, (Alt, Cat)) or (
+                    isinstance(p, Rep) and p.min >= 1):
+                sub = _best_candidate(p)
+                if sub is not None:
+                    cands.append(sub)
+        if not cands:
+            return None
+        return max(cands, key=lambda c: c.score)
+    if isinstance(node, Alt):
+        branches = []
+        total = 0
+        for o in node.options:
+            sub = _best_candidate(o)
+            if sub is None:
+                return None              # one branch unanchorable → fail
+            branches.append(sub)
+            total += len(sub.literals)
+        if total > 2 * MAX_ANCHOR_SET:
+            return None
+        merged = sorted(set(x for b in branches for x in b.literals))
+        return _Cand(merged)
+    if isinstance(node, Rep):
+        if node.min >= 1:
+            return _best_candidate(node.node)
+        return None
+    raise TypeError(node)
+
+
+@dataclass
+class RuleAnchor:
+    """Verification plan for one rule."""
+
+    anchored: bool
+    literals: list            # lowercased anchor literals (if anchored)
+    window: int               # max match length bound (if anchored)
+
+
+def analyze_rule(pattern: str, max_window: int = 2048) -> RuleAnchor:
+    """Build the verification plan for one rule regex.
+
+    ``max_window`` caps how large a bounded match we are willing to
+    verify through windows — beyond that, whole-file is cheaper.
+    """
+    try:
+        ast, extra = strip_elastic(parse(pattern))
+    except Exception:
+        return RuleAnchor(False, [], 0)
+    m = max_match_len(ast)
+    if m == INF or m > max_window:
+        return RuleAnchor(False, [], 0)
+    lits = anchor_literals(ast)
+    if not lits:
+        return RuleAnchor(False, [], 0)
+    # +2 slack keeps the edge-elastic soundness argument (a truncated
+    # whitespace run must retain ≥min+1 bytes inside the window).
+    return RuleAnchor(True, lits, int(m) + extra + 2)

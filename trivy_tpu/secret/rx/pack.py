@@ -75,7 +75,6 @@ def _forced_max(node, req_after: int) -> float:
     if isinstance(node, Alt):
         return max(_forced_max(o, req_after) for o in node.options)
     if isinstance(node, Cat):
-        total: float = 0
         suffix_req = req_after
         contributions = []
         for p in reversed(node.parts):

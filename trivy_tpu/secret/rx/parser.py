@@ -33,8 +33,17 @@ class RegexParseError(ValueError):
 
 @dataclass
 class Lit:
-    """One input byte drawn from a set."""
+    """One input unit drawn from a byte set.
+
+    ``ascii_only=True`` means the unit is exactly one ASCII byte in
+    the *decoded-text* regex too (explicit literals, explicit classes
+    and ranges). Shorthand escapes (``\\d \\w \\s`` and negations),
+    ``.`` and negated classes are Unicode-aware when the rule regex
+    runs over str — one unit can consume up to 4 UTF-8 bytes — and
+    carry ``ascii_only=False`` so byte-window math can account for it.
+    """
     bytes: frozenset
+    ascii_only: bool = True
 
 
 @dataclass
@@ -202,7 +211,7 @@ class _Parser:
             return self.char_class(flags)
         if c == ".":
             bs = ALL_BYTES if flags.dotall else ALL_BYTES - {0x0A}
-            return Lit(frozenset(bs))
+            return Lit(frozenset(bs), ascii_only=False)
         if c == "^":
             return Boundary("^")
         if c == "$":
@@ -214,6 +223,12 @@ class _Parser:
         return self._lit(ord(c), flags)
 
     def _lit(self, b: int, flags: _Flags) -> Lit:
+        if b >= 0x80:
+            # a non-ASCII literal char is 1 unit but 2-4 bytes in the
+            # str regex; modelling it as one byte corrupts both the
+            # DFA and window math — reject, the rule host-falls-back
+            raise RegexParseError(
+                f"non-ASCII literal U+{b:04X} in {self.p!r}")
         bs = frozenset([b])
         if flags.icase:
             bs = _fold_case(bs)
@@ -267,7 +282,7 @@ class _Parser:
             "s": _SPACE, "S": ALL_BYTES - _SPACE,
         }
         if c in table:
-            return Lit(frozenset(table[c]))
+            return Lit(frozenset(table[c]), ascii_only=False)
         if c == "b":
             return Boundary("b")
         if c == "B":
@@ -288,6 +303,7 @@ class _Parser:
             self.next()
             negate = True
         members: set = set()
+        unicode_aware = negate      # [^…] matches multibyte chars too
         first = True
         while True:
             c = self.peek()
@@ -299,6 +315,7 @@ class _Parser:
             first = False
             atom = self._class_atom(members)
             if atom is None:  # \d etc.: already merged into members
+                unicode_aware = True
                 continue
             if self.peek() == "-" and self.i + 1 < self.n and \
                     self.p[self.i + 1] != "]":
@@ -312,12 +329,15 @@ class _Parser:
                 members.update(range(a, b + 1))
             else:
                 members.update(atom)
+        if any(m >= 0x80 for m in members):
+            raise RegexParseError(
+                f"non-ASCII class member in {self.p!r}")
         bs = frozenset(members)
         if negate:
             bs = ALL_BYTES - bs
         if flags.icase:
             bs = _fold_case(bs)
-        return Lit(bs)
+        return Lit(bs, ascii_only=not unicode_aware)
 
     def _class_atom(self, members: set) -> Optional[frozenset]:
         """One class member. Multi-byte escapes (\\d …) merge straight
