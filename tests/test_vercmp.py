@@ -1,0 +1,270 @@
+"""Version grammar tests — edge cases mirror the reference modules'
+documented behaviors (go-deb-version, rpmvercmp, PEP 440, node-semver,
+Maven ComparableVersion, Gem::Version, apk-tools)."""
+
+import pytest
+
+from trivy_tpu.vercmp import get_comparer, is_vulnerable
+
+
+def cmp(name, a, b):
+    return get_comparer(name).compare(a, b)
+
+
+class TestSemver:
+    c = get_comparer("semver")
+
+    @pytest.mark.parametrize("a,b,want", [
+        ("1.2.3", "1.2.3", 0),
+        ("1.2.3", "1.2.4", -1),
+        ("1.2.3-alpha", "1.2.3", -1),
+        ("1.2.3-alpha.1", "1.2.3-alpha.2", -1),
+        ("1.2.3-alpha.9", "1.2.3-alpha.10", -1),
+        ("1.2.3-alpha", "1.2.3-beta", -1),
+        ("1.2.3-1", "1.2.3-alpha", -1),     # numeric < alphanumeric
+        ("v1.2.3", "1.2.3", 0),
+        ("1.2.3+build5", "1.2.3+build9", 0),  # build ignored
+        ("1.2", "1.2.0", 0),
+        ("2", "10", -1),
+    ])
+    def test_compare(self, a, b, want):
+        assert self.c.compare(a, b) == want
+
+    @pytest.mark.parametrize("ver,constraint,want", [
+        ("1.5.0", ">=1.2.3, <2.0.0", True),
+        ("2.0.0", ">=1.2.3, <2.0.0", False),
+        ("1.2.3", "=1.2.3", True),
+        ("1.2.4", "!=1.2.3", True),
+        ("1.2.3", "!=1.2.3", False),
+        ("1.4.9", "~>1.4.2", True),
+        ("1.5.0", "~>1.4.2", False),
+        ("1.6.0", "~>1.4", True),            # pessimistic: <2.0
+        ("2.0.0", "~>1.4", False),
+        ("1.9.9", "^1.2.3", True),
+        ("2.0.0", "^1.2.3", False),
+        ("0.2.5", "^0.2.3", True),
+        ("0.3.0", "^0.2.3", False),
+        ("1.2.5", "~1.2.3", True),
+        ("1.3.0", "~1.2.3", False),
+        ("1.2.7", "1.2.*", True),
+        ("1.3.0", "1.2.*", False),
+        ("0.9.0", ">=0.8.0 <1.0.0", True),
+        ("1.0.0", "*", True),
+        ("1.0.0-rc1", "<1.0.0", True),       # prerelease below release
+        ("2.5.0", ">2.4 || <1.0", True),
+        ("1.5.0", ">2.4 || <1.0", False),
+    ])
+    def test_match(self, ver, constraint, want):
+        assert self.c.match(ver, constraint) is want
+
+
+class TestDeb:
+    @pytest.mark.parametrize("a,b,want", [
+        ("1.2.3-1", "1.2.3-2", -1),
+        ("1:1.0", "2.0", 1),                 # epoch wins
+        ("0:1.0", "1.0", 0),
+        ("1.0~rc1", "1.0", -1),              # ~ before everything
+        ("1.0~rc1-1", "1.0~rc1", 1),
+        ("2.2.4-1ubuntu0.1", "2.2.4-1", 1),
+        ("1.0a", "1.0+", -1),                # letters before symbols
+        ("09", "9", 0),
+        ("1.10", "1.9", 1),
+        ("7.6p2-4", "7.6-0", 1),
+        ("1.0.5+dfsg-2", "1.0.5-1", 1),
+    ])
+    def test_compare(self, a, b, want):
+        assert cmp("deb", a, b) == want
+
+
+class TestRpm:
+    @pytest.mark.parametrize("a,b,want", [
+        ("1.0", "1.0", 0),
+        ("1.0", "2.0", -1),
+        ("2.0.1", "2.0.1", 0),
+        ("2.0", "2.0.1", -1),
+        ("5.16.1.3-1.el6", "5.16.1.3-9.el6", -1),
+        ("1:1.0", "2.0", 1),
+        ("1.0~rc1", "1.0", -1),
+        ("1.0^git1", "1.0", 1),
+        ("1.0^git1", "1.0.1", -1),
+        ("1.0a", "1.0.1", -1),               # alpha < digit segment
+        ("FC5", "fc4", -1),                  # case-sensitive strcmp
+        ("2a", "2.0", -1),
+        ("1.0010", "1.9", 1),                # numeric, zeros stripped
+    ])
+    def test_compare(self, a, b, want):
+        assert cmp("rpm", a, b) == want
+
+
+class TestApk:
+    @pytest.mark.parametrize("a,b,want", [
+        ("1.2.3-r0", "1.2.3-r1", -1),
+        ("1.2.3", "1.2.3-r0", 0),
+        ("1.2.3_alpha", "1.2.3", -1),
+        ("1.2.3_alpha1", "1.2.3_alpha2", -1),
+        ("1.2.3_rc1", "1.2.3_pre1", 1),
+        ("1.2.3_p1", "1.2.3", 1),            # patch suffix after
+        ("1.2.3a", "1.2.3b", -1),
+        ("1.2.3", "1.2.3a", -1),
+        ("1.10", "1.9", 1),
+        ("1.05", "1.1", -1),                 # fractional leading zero
+        ("2.10.1", "2.9.0", 1),
+    ])
+    def test_compare(self, a, b, want):
+        assert cmp("apk", a, b) == want
+
+
+class TestPep440:
+    c = get_comparer("pip")
+
+    @pytest.mark.parametrize("a,b,want", [
+        ("1.0", "1.0.0", 0),
+        ("1.0a1", "1.0", -1),
+        ("1.0.dev1", "1.0a1", -1),
+        ("1.0a1.dev1", "1.0a1", -1),
+        ("1.0a2", "1.0b1", -1),
+        ("1.0rc1", "1.0", -1),
+        ("1.0", "1.0.post1", -1),
+        ("1.0.post1", "1.1", -1),
+        ("1!0.5", "2.0", 1),                 # epoch
+        ("1.0+local", "1.0", 1),
+        ("1.0+abc.2", "1.0+abc.10", -1),
+        ("1.0-1", "1.0.post1", 0),           # implicit post
+        ("1.0alpha1", "1.0a1", 0),
+    ])
+    def test_compare(self, a, b, want):
+        assert self.c.compare(a, b) == want
+
+    @pytest.mark.parametrize("ver,constraint,want", [
+        ("1.5", ">=1.2,<2.0", True),
+        ("2.0", ">=1.2,<2.0", False),
+        ("1.4.5", "~=1.4.2", True),
+        ("1.5.0", "~=1.4.2", False),
+        ("1.9", "~=1.4", True),              # ~=1.4 → <2.0
+        ("2.0", "~=1.4", False),
+        ("1.4.7", "==1.4.*", True),
+        ("1.5.0", "==1.4.*", False),
+        ("1.4.0a1", "==1.4.*", True),        # prereleases in wildcard
+        ("1.0", "!=1.0", False),
+    ])
+    def test_match(self, ver, constraint, want):
+        assert self.c.match(ver, constraint) is want
+
+
+class TestNpm:
+    c = get_comparer("npm")
+
+    @pytest.mark.parametrize("ver,constraint,want", [
+        ("4.0.10", ">=4.0.0 <4.0.14", True),
+        ("4.0.14", ">=4.0.0 <4.0.14", False),
+        ("1.2.5", "~1.2.3", True),
+        ("1.3.0", "~1.2.3", False),
+        ("1.9.1", "^1.2.3", True),
+        ("2.0.0", "^1.2.3", False),
+        ("0.2.4", "^0.2.3", True),
+        ("0.3.0", "^0.2.3", False),
+        ("1.2.9", "1.2.x", True),
+        ("1.3.0", "1.2.x", False),
+        ("1.5.0", "1.x", True),
+        ("2.0.0", "1.x", False),
+        ("1.7.0", "1.2.3 - 2.0.0", True),
+        ("2.0.1", "1.2.3 - 2.0.0", False),
+        ("1.5.0", "*", True),
+        ("2.5.0", "<1.0.0 || >=2.0.0", True),
+        ("1.5.0", "<1.0.0 || >=2.0.0", False),
+        ("1.2.3-alpha.1", "<1.2.3", True),
+        ("1.5.0", "1.2", False),             # 1.2 = [1.2.0, 1.3.0)
+        ("1.2.9", "1.2", True),
+    ])
+    def test_match(self, ver, constraint, want):
+        assert self.c.match(ver, constraint) is want
+
+
+class TestMaven:
+    c = get_comparer("maven")
+
+    @pytest.mark.parametrize("a,b,want", [
+        ("1", "1.0.0", 0),
+        ("1-ga", "1", 0),
+        ("1-final", "1", 0),
+        ("1-alpha", "1", -1),
+        ("1-beta", "1-alpha", 1),
+        ("1-milestone", "1-beta", 1),
+        ("1-rc", "1-milestone", 1),
+        ("1-cr", "1-rc", 0),
+        ("1-snapshot", "1-rc", 1),
+        ("1-snapshot", "1", -1),
+        ("1-sp", "1", 1),
+        ("1-sp", "1.1", -1),
+        ("1-xyz", "1-sp", 1),                # unknown qualifier last
+        ("2.13.0", "2.13.1", -1),
+        ("1.0-alpha-1", "1.0-alpha-2", -1),
+        ("1.0.0-RELEASE", "1.0.0", 0),
+    ])
+    def test_compare(self, a, b, want):
+        assert self.c.compare(a, b) == want
+
+    @pytest.mark.parametrize("ver,constraint,want", [
+        ("2.13.0", ">=2.13.0, <2.13.3", True),
+        ("2.13.3", ">=2.13.0, <2.13.3", False),
+        ("1.5", "[1.0,2.0)", True),
+        ("2.0", "[1.0,2.0)", False),
+        ("2.0", "[1.0,2.0]", True),
+        ("0.5", "(,1.0]", True),
+        ("1.0", "[1.0]", True),
+        ("1.1", "[1.0]", False),
+    ])
+    def test_match(self, ver, constraint, want):
+        assert self.c.match(ver, constraint) is want
+
+
+class TestRubygems:
+    c = get_comparer("rubygems")
+
+    @pytest.mark.parametrize("a,b,want", [
+        ("1.0", "1.0.0", 0),
+        ("1.0.a", "1.0", -1),
+        ("1.0.a1", "1.0.a2", -1),
+        ("1.0.b1", "1.0.a2", 1),
+        ("1.0-rc1", "1.0.pre.rc1", 0),       # '-' → '.pre.'
+        ("1.8.2", "1.8.2.1", -1),
+        ("0.9", "1.0.a", -1),
+    ])
+    def test_compare(self, a, b, want):
+        assert self.c.compare(a, b) == want
+
+    @pytest.mark.parametrize("ver,constraint,want", [
+        ("1.4.5", "~> 1.4.2", True),
+        ("1.5.0", "~> 1.4.2", False),
+        ("1.9", "~> 1.4", True),
+        ("2.0", "~> 1.4", False),
+        ("6.1.7.1", ">= 6.1.7.1", True),
+        ("6.1.7", ">= 6.1.7.1", False),
+        ("3.0.0", ">= 2.2, < 3.1", True),
+    ])
+    def test_match(self, ver, constraint, want):
+        assert self.c.match(ver, constraint) is want
+
+
+class TestIsVulnerable:
+    def test_reference_semantics(self):
+        c = get_comparer("semver")
+        # vulnerable ∧ ¬patched
+        assert is_vulnerable(c, "1.2.0", ["<1.3.0"], ["1.2.5"], [])\
+            is True
+        assert is_vulnerable(c, "1.2.5", ["<1.3.0"], ["1.2.5"], [])\
+            is False
+        # empty string anywhere ⇒ vulnerable
+        assert is_vulnerable(c, "9.9.9", [""], [], []) is True
+        assert is_vulnerable(c, "9.9.9", ["<1.0"], [""], []) is True
+        # no vulnerable versions + no secure ⇒ not vulnerable
+        assert is_vulnerable(c, "1.0.0", [], [], []) is False
+        # no vulnerable versions + patched present ⇒ ¬matched(secure)
+        assert is_vulnerable(c, "1.0.0", [], [">=2.0.0"], []) is True
+        assert is_vulnerable(c, "2.5.0", [], [">=2.0.0"], []) is False
+        # unaffected counts as secure
+        assert is_vulnerable(c, "0.5.0", ["<1.0.0"], [], ["0.5.0"])\
+            is False
+        # parse errors ⇒ not vulnerable
+        assert is_vulnerable(c, "not-a-version", ["<1.0"], [], [])\
+            is False

@@ -1,0 +1,140 @@
+"""Registry + group + result merging."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..types import OS, BlobInfo, Repository
+
+_REGISTRY: list = []
+
+
+class Analyzer:
+    """Base analyzer. Subclasses set ``type``/``version`` and implement
+    ``required(path, size)`` + ``analyze(path, content)``."""
+
+    type: str = ""
+    version: int = 1
+
+    def required(self, path: str, size: Optional[int] = None) -> bool:
+        raise NotImplementedError
+
+    def analyze(self, path: str, content: bytes)\
+            -> "AnalysisResult":
+        raise NotImplementedError
+
+
+def register_analyzer(a) -> "Analyzer":
+    """Usable as ``@register_analyzer`` on a class (instantiates it)
+    or called with an instance."""
+    _REGISTRY.append(a() if isinstance(a, type) else a)
+    return a
+
+
+def registered_analyzers() -> list:
+    return list(_REGISTRY)
+
+
+@dataclass
+class AnalysisResult:
+    """Mergeable fragment (reference: analyzer.go AnalysisResult)."""
+
+    os: Optional[OS] = None
+    repository: Optional[Repository] = None
+    package_infos: list = field(default_factory=list)
+    applications: list = field(default_factory=list)
+    config_files: list = field(default_factory=list)
+    secrets: list = field(default_factory=list)
+    licenses: list = field(default_factory=list)
+    system_files: list = field(default_factory=list)
+    custom_resources: list = field(default_factory=list)
+    secret_candidates: list = field(default_factory=list)  # (path, data)
+
+    def merge(self, other: "AnalysisResult") -> None:
+        if other is None:
+            return
+        if other.os is not None:
+            self.os = _merge_os(self.os, other.os)
+        if other.repository is not None:
+            self.repository = other.repository
+        self.package_infos.extend(other.package_infos)
+        self.applications.extend(other.applications)
+        self.config_files.extend(other.config_files)
+        self.secrets.extend(other.secrets)
+        self.licenses.extend(other.licenses)
+        self.system_files.extend(other.system_files)
+        self.custom_resources.extend(other.custom_resources)
+        self.secret_candidates.extend(other.secret_candidates)
+
+    def to_blob_info(self, diff_id: str = "", digest: str = "")\
+            -> BlobInfo:
+        self.package_infos.sort(key=lambda p: p.file_path)
+        self.applications.sort(key=lambda a: a.file_path)
+        return BlobInfo(
+            diff_id=diff_id,
+            digest=digest,
+            os=self.os,
+            repository=self.repository,
+            package_infos=self.package_infos,
+            applications=self.applications,
+            config_files=self.config_files,
+            secrets=self.secrets,
+            licenses=self.licenses,
+            system_files=self.system_files,
+            custom_resources=self.custom_resources,
+        )
+
+
+def _merge_os(old: Optional[OS], new: OS) -> OS:
+    """OS.Merge semantics (fanal types): later analyzers fill gaps;
+    the 'release' file never overrides a specific family; ubuntu wins
+    over debian (ubuntu ships /etc/debian_version too)."""
+    if old is None:
+        return new
+    if old.family and new.family and old.family != new.family:
+        # specific families beat the generic os-release fallback;
+        # the version must come from the WINNING family's analyzer
+        # (ubuntu 22.04 + debian bookworm/sid must not mix)
+        if new.family == "ubuntu" or (old.family == "debian"
+                                      and new.family != "debian"):
+            family, name = new.family, (new.name or old.name)
+        else:
+            family, name = old.family, (old.name or new.name)
+        return OS(family=family, name=name, eosl=old.eosl or new.eosl)
+    return OS(family=new.family or old.family,
+              name=new.name or old.name,
+              eosl=old.eosl or new.eosl,
+              extended=old.extended or new.extended)
+
+
+class AnalyzerGroup:
+    """Fans a file out to all matching analyzers
+    (analyzer.go:393-447; the goroutine pool becomes a plain loop —
+    parallelism lives in the batched kernels, not host threads)."""
+
+    def __init__(self, disabled: Optional[list] = None,
+                 file_patterns: Optional[dict] = None):
+        self.disabled = set(disabled or [])
+        # --file-patterns TYPE:regex overrides (analyzer.go:464)
+        self.patterns = {t: re.compile(p)
+                         for t, p in (file_patterns or {}).items()}
+        self.analyzers = [a for a in registered_analyzers()
+                          if a.type not in self.disabled]
+
+    def versions(self) -> dict:
+        return {a.type: a.version for a in self.analyzers}
+
+    def analyze_file(self, result: AnalysisResult, path: str,
+                     content_fn: Callable, size: int) -> None:
+        content = None          # read once, shared by all analyzers
+        for a in self.analyzers:
+            pat = self.patterns.get(a.type)
+            if pat is not None and pat.search(path):
+                pass                      # forced by --file-patterns
+            elif not a.required(path, size):
+                continue
+            if content is None:
+                content = content_fn()
+            result.merge(a.analyze(path, content))

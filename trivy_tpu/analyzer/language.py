@@ -1,0 +1,264 @@
+"""Language lockfile analyzers (reference: go-dep-parser via
+pkg/fanal/analyzer/language/* — SURVEY.md §2.2).
+
+Each analyzer parses one lockfile format into an Application with
+Libraries; detection runs later against the ecosystem buckets.
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+import re
+
+from ..types import Application, Package
+from .analyzer import AnalysisResult, Analyzer, register_analyzer
+
+
+def _app(app_type: str, path: str, pkgs: list) -> AnalysisResult:
+    if not pkgs:
+        return None
+    return AnalysisResult(applications=[
+        Application(type=app_type, file_path=path, libraries=pkgs)])
+
+
+def _lib(name: str, version: str, indirect: bool = False) -> Package:
+    return Package(id=f"{name}@{version}", name=name, version=version,
+                   indirect=indirect)
+
+
+@register_analyzer
+class NpmLockAnalyzer(Analyzer):
+    type = "npm"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "package-lock.json"
+
+    def analyze(self, path, content):
+        try:
+            data = json.loads(content)
+        except ValueError:
+            return None
+        pkgs: dict = {}
+        if "packages" in data:           # lockfile v2/v3
+            for p, meta in data["packages"].items():
+                if not p or not isinstance(meta, dict):
+                    continue
+                name = meta.get("name") or p.split("node_modules/")[-1]
+                ver = meta.get("version", "")
+                if name and ver:
+                    pkgs[(name, ver)] = _lib(
+                        name, ver, indirect=bool(meta.get("dev")))
+        else:                            # v1: dependencies tree
+            def walk(deps, depth):
+                for name, meta in (deps or {}).items():
+                    ver = meta.get("version", "")
+                    if ver:
+                        pkgs.setdefault(
+                            (name, ver),
+                            _lib(name, ver, indirect=depth > 0))
+                    walk(meta.get("dependencies"), depth + 1)
+            walk(data.get("dependencies"), 0)
+        return _app("npm", path, sorted(pkgs.values(),
+                                        key=lambda p: p.id))
+
+
+_YARN_HEADER = re.compile(r'^"?(?P<name>(?:@[^@/"]+/)?[^@/"]+)@')
+_YARN_VERSION = re.compile(r'^\s+version:?\s+"?([^"\s]+)"?')
+
+
+@register_analyzer
+class YarnLockAnalyzer(Analyzer):
+    type = "yarn"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "yarn.lock"
+
+    def analyze(self, path, content):
+        pkgs: dict = {}
+        name = None
+        for line in content.decode("utf-8", "replace").splitlines():
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            if not line.startswith((" ", "\t")):
+                m = _YARN_HEADER.match(line.strip())
+                name = m.group("name") if m else None
+                continue
+            m = _YARN_VERSION.match(line)
+            if m and name:
+                pkgs[(name, m.group(1))] = _lib(name, m.group(1))
+        return _app("yarn", path, sorted(pkgs.values(),
+                                         key=lambda p: p.id))
+
+
+@register_analyzer
+class PipfileLockAnalyzer(Analyzer):
+    type = "pipenv"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "Pipfile.lock"
+
+    def analyze(self, path, content):
+        try:
+            data = json.loads(content)
+        except ValueError:
+            return None
+        pkgs = []
+        for section in ("default", "develop"):
+            for name, meta in (data.get(section) or {}).items():
+                ver = (meta.get("version") or "").lstrip("=")
+                if ver:
+                    pkgs.append(_lib(name, ver))
+        return _app("pipenv", path, pkgs)
+
+
+@register_analyzer
+class PoetryLockAnalyzer(Analyzer):
+    type = "poetry"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "poetry.lock"
+
+    def analyze(self, path, content):
+        import tomllib
+        try:
+            data = tomllib.loads(content.decode("utf-8", "replace"))
+        except tomllib.TOMLDecodeError:
+            return None
+        pkgs = [_lib(p.get("name", ""), str(p.get("version", "")))
+                for p in data.get("package", [])
+                if p.get("name") and p.get("version")]
+        return _app("poetry", path, pkgs)
+
+
+@register_analyzer
+class RequirementsAnalyzer(Analyzer):
+    """requirements.txt with pinned versions (reference: pip)."""
+
+    type = "pip"
+    version = 1
+
+    _LINE = re.compile(
+        r"^(?P<name>[A-Za-z0-9._-]+)\s*==\s*(?P<ver>[^\s;#]+)")
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "requirements.txt"
+
+    def analyze(self, path, content):
+        pkgs = []
+        for line in content.decode("utf-8", "replace").splitlines():
+            m = self._LINE.match(line.strip())
+            if m:
+                pkgs.append(_lib(m.group("name"), m.group("ver")))
+        return _app("pip", path, pkgs)
+
+
+_GEM_SPEC_LINE = re.compile(r"^    (\S+) \(([^)]+)\)$")
+
+
+@register_analyzer
+class GemfileLockAnalyzer(Analyzer):
+    type = "bundler"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "Gemfile.lock"
+
+    def analyze(self, path, content):
+        pkgs = []
+        in_specs = False
+        for line in content.decode("utf-8", "replace").splitlines():
+            if line.strip() == "specs:":
+                in_specs = True
+                continue
+            if in_specs:
+                if line and not line.startswith(" "):
+                    in_specs = False
+                    continue
+                m = _GEM_SPEC_LINE.match(line)
+                if m:
+                    pkgs.append(_lib(m.group(1), m.group(2)))
+        return _app("bundler", path, pkgs)
+
+
+@register_analyzer
+class ComposerLockAnalyzer(Analyzer):
+    type = "composer"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "composer.lock"
+
+    def analyze(self, path, content):
+        try:
+            data = json.loads(content)
+        except ValueError:
+            return None
+        pkgs = []
+        for section, indirect in (("packages", False),
+                                  ("packages-dev", True)):
+            for p in data.get(section) or []:
+                name, ver = p.get("name", ""), p.get("version", "")
+                if name and ver:
+                    pkgs.append(_lib(name, ver.lstrip("v"), indirect))
+        return _app("composer", path, pkgs)
+
+
+@register_analyzer
+class CargoLockAnalyzer(Analyzer):
+    type = "cargo"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "Cargo.lock"
+
+    def analyze(self, path, content):
+        import tomllib
+        try:
+            data = tomllib.loads(content.decode("utf-8", "replace"))
+        except tomllib.TOMLDecodeError:
+            return None
+        pkgs = [_lib(p.get("name", ""), str(p.get("version", "")))
+                for p in data.get("package", [])
+                if p.get("name") and p.get("version")]
+        return _app("cargo", path, pkgs)
+
+
+_GOMOD_REQUIRE = re.compile(
+    r"^\s*(?P<mod>[^\s]+)\s+(?P<ver>v[^\s/]+)(?:\s*//.*)?$")
+
+
+@register_analyzer
+class GoModAnalyzer(Analyzer):
+    type = "gomod"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) == "go.mod"
+
+    def analyze(self, path, content):
+        pkgs = []
+        in_require = False
+        for line in content.decode("utf-8", "replace").splitlines():
+            stripped = line.strip()
+            if stripped.startswith("require ("):
+                in_require = True
+                continue
+            if in_require and stripped == ")":
+                in_require = False
+                continue
+            m = None
+            if in_require:
+                m = _GOMOD_REQUIRE.match(stripped)
+            elif stripped.startswith("require "):
+                m = _GOMOD_REQUIRE.match(
+                    stripped[len("require "):])
+            if m:
+                indirect = "// indirect" in line
+                pkgs.append(_lib(m.group("mod"),
+                                 m.group("ver").lstrip("v"), indirect))
+        return _app("gomod", path, pkgs)

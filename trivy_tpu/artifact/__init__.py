@@ -1,0 +1,15 @@
+"""Artifact inspection: images, filesystems → cached BlobInfos.
+
+Reference: pkg/fanal/artifact (SURVEY.md §2.2). The pipeline shape is
+preserved — resolve → content-addressed cache keys → analyze only
+missing blobs → PutBlob — but per-layer goroutines become one batched
+TPU dispatch over every layer's secret candidates.
+"""
+
+from .artifact import ArtifactOption, ImageArtifact, LocalFSArtifact
+from .cache import FSCache, MemoryCache, calc_key
+from .image import ImageSource, load_image
+
+__all__ = ["ArtifactOption", "ImageArtifact", "LocalFSArtifact",
+           "FSCache", "MemoryCache", "calc_key", "ImageSource",
+           "load_image"]

@@ -1,0 +1,196 @@
+"""Image and filesystem artifacts (reference:
+pkg/fanal/artifact/image/image.go + artifact/local/fs.go).
+
+Inspect flow (image.go:75-257): compute content-addressed cache keys
+per layer → ask the cache which are missing → analyze only those →
+PutBlob. The reference analyzes layers in parallel goroutines with a
+per-file semaphore; here every missing layer's files are analyzed on
+the host (parsers are irregular), while ALL layers' secret candidates
+go to the TPU in one batched sieve dispatch — the batch dimension
+replaces the goroutine pool.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..analyzer import AnalyzerGroup
+from ..analyzer.analyzer import AnalysisResult
+from ..types import (ArtifactInfo, ArtifactReference, BlobInfo,
+                     ImageMetadata, Secret)
+from ..utils import get_logger
+from .cache import calc_key
+from .image import ImageSource
+from .walker import collect_layer_tar, walk_fs
+
+log = get_logger("artifact")
+
+
+@dataclass
+class ArtifactOption:
+    disabled_analyzers: list = field(default_factory=list)
+    skip_dirs: list = field(default_factory=list)
+    skip_files: list = field(default_factory=list)
+    file_patterns: dict = field(default_factory=dict)
+    no_progress: bool = True
+    insecure: bool = False
+    secret_scanner: object = None      # BatchSecretScanner (shared)
+    scan_secrets: bool = True
+
+
+def _secret_scanner(opt: ArtifactOption):
+    if opt.secret_scanner is None:
+        from ..secret.batch import BatchSecretScanner
+        opt.secret_scanner = BatchSecretScanner()
+    return opt.secret_scanner
+
+
+class ImageArtifact:
+    def __init__(self, image: ImageSource, cache,
+                 option: Optional[ArtifactOption] = None):
+        self.image = image
+        self.cache = cache
+        self.opt = option or ArtifactOption()
+        self.group = AnalyzerGroup(
+            disabled=self.opt.disabled_analyzers,
+            file_patterns=self.opt.file_patterns)
+
+    def inspect(self) -> ArtifactReference:
+        img = self.image
+        opts_key = {"skip_dirs": self.opt.skip_dirs,
+                    "skip_files": self.opt.skip_files,
+                    "patterns": sorted(self.opt.file_patterns),
+                    "secrets": self.opt.scan_secrets}
+        versions = self.group.versions()
+        blob_ids = [calc_key(d, versions, options=opts_key)
+                    for d in img.diff_ids]
+        artifact_id = calc_key(img.id, versions, options=opts_key)
+
+        missing_artifact, missing = self.cache.missing_blobs(
+            artifact_id, blob_ids)
+
+        todo = [i for i, b in enumerate(blob_ids) if b in missing]
+        if todo:
+            self._inspect_layers(todo, blob_ids)
+        if missing_artifact:
+            self.cache.put_artifact(artifact_id,
+                                    self._artifact_info())
+
+        return ArtifactReference(
+            name=img.name,
+            type="container_image",
+            id=artifact_id,
+            blob_ids=blob_ids,
+            image_metadata=ImageMetadata(
+                id=img.id,
+                diff_ids=img.diff_ids,
+                repo_tags=img.repo_tags,
+                repo_digests=img.repo_digests,
+                image_config=img.config,
+            ),
+        )
+
+    # --- analysis ---
+
+    def _inspect_layers(self, todo: list, blob_ids: list) -> None:
+        layer_results = []
+        all_candidates = []        # (layer_idx, path, content)
+        for i in todo:
+            layer = self.image.layers[i]
+            result = AnalysisResult()
+            with layer.open() as tf:
+                files, opq_dirs, wh_files = collect_layer_tar(tf)
+                for path, size, read in files:
+                    if self._skipped(path):
+                        continue
+                    self.group.analyze_file(result, path, read, size)
+            layer_results.append((i, result, opq_dirs, wh_files))
+            for path, content in result.secret_candidates:
+                all_candidates.append((i, path, content))
+
+        secrets_by_layer = self._batch_secrets(all_candidates)
+
+        for i, result, opq_dirs, wh_files in layer_results:
+            result.secrets = secrets_by_layer.get(i, [])
+            blob = result.to_blob_info(diff_id=self.image.diff_ids[i])
+            blob.opaque_dirs = opq_dirs
+            blob.whiteout_files = wh_files
+            self.cache.put_blob(blob_ids[i], blob)
+
+    def _batch_secrets(self, candidates: list) -> dict:
+        """ONE kernel dispatch across every missing layer's files.
+        Image paths get a leading '/' (secret.go:97-101). The same
+        path can exist in several layers with different contents —
+        results map back by ENTRY ORDER (scan_files preserves it),
+        never by path alone."""
+        if not candidates or not self.opt.scan_secrets:
+            return {}
+        scanner = _secret_scanner(self.opt)
+        files = [("/" + path, content)
+                 for _, path, content in candidates]
+        found = scanner.scan_files(files)
+        out: dict = {}
+        ci = 0
+        for s in found:
+            while ci < len(candidates) and \
+                    "/" + candidates[ci][1] != s.file_path:
+                ci += 1
+            if ci == len(candidates):
+                break
+            out.setdefault(candidates[ci][0], []).append(s)
+            ci += 1
+        return out
+
+    def _skipped(self, path: str) -> bool:
+        for d in self.opt.skip_dirs:
+            d = d.strip("/")
+            if path == d or path.startswith(d + "/"):
+                return True
+        return ("/" + path if not path.startswith("/") else path)\
+            in self.opt.skip_files or path in self.opt.skip_files
+
+    def _artifact_info(self) -> ArtifactInfo:
+        cfg = self.image.config
+        return ArtifactInfo(
+            architecture=cfg.get("architecture", ""),
+            created=cfg.get("created", ""),
+            docker_version=cfg.get("docker_version", ""),
+            os=cfg.get("os", ""),
+        )
+
+
+class LocalFSArtifact:
+    """Directory tree → ONE blob (reference: artifact/local/fs.go)."""
+
+    def __init__(self, root: str, cache,
+                 option: Optional[ArtifactOption] = None):
+        self.root = root
+        self.cache = cache
+        self.opt = option or ArtifactOption()
+        self.group = AnalyzerGroup(
+            disabled=self.opt.disabled_analyzers,
+            file_patterns=self.opt.file_patterns)
+
+    def inspect(self) -> ArtifactReference:
+        result = AnalysisResult()
+        files = walk_fs(self.root, skip_dirs=self.opt.skip_dirs,
+                        skip_files=self.opt.skip_files)
+        for path, size, read in files:
+            self.group.analyze_file(result, path, read, size)
+
+        if result.secret_candidates and self.opt.scan_secrets:
+            scanner = _secret_scanner(self.opt)
+            result.secrets = scanner.scan_files(
+                [(p, c) for p, c in result.secret_candidates])
+
+        blob = result.to_blob_info()
+        raw = json.dumps(blob.to_dict(), sort_keys=True).encode()
+        blob_id = "sha256:" + hashlib.sha256(raw).hexdigest()
+        blob.diff_id = blob_id
+        self.cache.put_blob(blob_id, blob)
+        return ArtifactReference(
+            name=self.root, type="filesystem", id=blob_id,
+            blob_ids=[blob_id])

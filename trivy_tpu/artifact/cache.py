@@ -1,0 +1,233 @@
+"""Content-addressed layer cache (reference: pkg/fanal/cache).
+
+``missing_blobs`` is the resume mechanism (SURVEY.md §5): a re-run
+only analyzes layers whose (diffID × analyzer versions × options) key
+is absent. Keys: SHA-256 over id + sorted version map + scan options
+(cache/key.go:14). Backends: in-memory and JSON-files-on-disk (the
+BoltDB analog; one file per blob keeps writes atomic and debuggable).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from ..types import ArtifactInfo, BlobInfo
+
+SCHEMA_VERSION = 2
+
+
+def calc_key(id_: str, analyzer_versions: dict,
+             hook_versions: Optional[dict] = None,
+             options: Optional[dict] = None) -> str:
+    h = hashlib.sha256()
+    payload = {
+        "id": id_,
+        "analyzers": dict(sorted((analyzer_versions or {}).items())),
+        "hooks": dict(sorted((hook_versions or {}).items())),
+        "options": options or {},
+        "schema": SCHEMA_VERSION,
+    }
+    h.update(json.dumps(payload, sort_keys=True,
+                        separators=(",", ":")).encode())
+    return "sha256:" + h.hexdigest()
+
+
+class MemoryCache:
+    """ArtifactCache + LocalArtifactCache in one (cache.go:16-48)."""
+
+    def __init__(self):
+        self.artifacts: dict = {}
+        self.blobs: dict = {}
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list) -> tuple:
+        """(missing_artifact, missing_blob_ids)"""
+        missing = [b for b in blob_ids if b not in self.blobs]
+        return artifact_id not in self.artifacts, missing
+
+    def put_artifact(self, artifact_id: str, info) -> None:
+        self.artifacts[artifact_id] = info
+
+    def put_blob(self, blob_id: str, blob) -> None:
+        self.blobs[blob_id] = blob
+
+    def get_artifact(self, artifact_id: str):
+        return self.artifacts.get(artifact_id)
+
+    def get_blob(self, blob_id: str):
+        return self.blobs.get(blob_id)
+
+    def delete_blobs(self, blob_ids: list) -> None:
+        for b in blob_ids:
+            self.blobs.pop(b, None)
+
+    def clear(self) -> None:
+        self.artifacts.clear()
+        self.blobs.clear()
+
+
+class FSCache(MemoryCache):
+    """Disk-backed cache under ``<dir>/fanal`` — JSON per entry."""
+
+    def __init__(self, cache_dir: str):
+        super().__init__()
+        self.dir = os.path.join(cache_dir, "fanal")
+        os.makedirs(os.path.join(self.dir, "artifact"), exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "blob"), exist_ok=True)
+
+    def _path(self, kind: str, key: str) -> str:
+        return os.path.join(self.dir, kind,
+                            key.replace(":", "_") + ".json")
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list) -> tuple:
+        missing = [b for b in blob_ids
+                   if not os.path.exists(self._path("blob", b))]
+        return (not os.path.exists(
+            self._path("artifact", artifact_id)), missing)
+
+    def put_artifact(self, artifact_id: str, info) -> None:
+        self._write("artifact", artifact_id, info)
+
+    def put_blob(self, blob_id: str, blob) -> None:
+        self._write("blob", blob_id, blob)
+
+    def get_artifact(self, artifact_id: str):
+        raw = self._read("artifact", artifact_id)
+        return None if raw is None else _artifact_from_dict(raw)
+
+    def get_blob(self, blob_id: str):
+        raw = self._read("blob", blob_id)
+        return None if raw is None else _blob_from_dict(raw)
+
+    def delete_blobs(self, blob_ids: list) -> None:
+        for b in blob_ids:
+            try:
+                os.unlink(self._path("blob", b))
+            except FileNotFoundError:
+                pass
+
+    def clear(self) -> None:
+        import shutil
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def _write(self, kind: str, key: str, obj) -> None:
+        path = self._path(kind, key)
+        tmp = path + ".tmp"
+        data = obj.to_dict() if hasattr(obj, "to_dict") else obj
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def _read(self, kind: str, key: str):
+        try:
+            with open(self._path(kind, key), encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+
+def _blob_from_dict(d: dict) -> BlobInfo:
+    """JSON → BlobInfo (inverse of asdict_omitempty for the fields the
+    applier consumes)."""
+    from ..types import (OS, Application, ConfigFile, Package,
+                         PackageInfo, Repository, Secret,
+                         SecretFinding)
+    from ..types.common import Code, Layer, Line
+
+    def layer(x):
+        return Layer(digest=x.get("Digest", ""),
+                     diff_id=x.get("DiffID", "")) if x else Layer()
+
+    def pkg(x):
+        return Package(
+            id=x.get("ID", ""), name=x.get("Name", ""),
+            version=x.get("Version", ""), release=x.get("Release", ""),
+            epoch=x.get("Epoch", 0), arch=x.get("Arch", ""),
+            src_name=x.get("SrcName", ""),
+            src_version=x.get("SrcVersion", ""),
+            src_release=x.get("SrcRelease", ""),
+            src_epoch=x.get("SrcEpoch", 0),
+            licenses=x.get("Licenses") or [],
+            modularity_label=x.get("Modularitylabel", ""),
+            indirect=x.get("Indirect", False),
+            depends_on=x.get("DependsOn") or [],
+            layer=layer(x.get("Layer")),
+            file_path=x.get("FilePath", ""),
+            ref=x.get("Ref", ""),
+        )
+
+    def finding(x):
+        code = Code(lines=[
+            Line(number=ln.get("Number", 0),
+                 content=ln.get("Content", ""),
+                 is_cause=ln.get("IsCause", False),
+                 annotation=ln.get("Annotation", ""),
+                 truncated=ln.get("Truncated", False),
+                 highlighted=ln.get("Highlighted", ""),
+                 first_cause=ln.get("FirstCause", False),
+                 last_cause=ln.get("LastCause", False))
+            for ln in (x.get("Code") or {}).get("Lines") or []])
+        return SecretFinding(
+            rule_id=x.get("RuleID", ""),
+            category=x.get("Category", ""),
+            severity=x.get("Severity", ""),
+            title=x.get("Title", ""),
+            start_line=x.get("StartLine", 0),
+            end_line=x.get("EndLine", 0),
+            code=code, match=x.get("Match", ""),
+            layer=layer(x.get("Layer")))
+
+    os_ = None
+    if d.get("OS"):
+        os_ = OS(family=d["OS"].get("Family", ""),
+                 name=d["OS"].get("Name", ""),
+                 eosl=d["OS"].get("Eosl", False),
+                 extended=d["OS"].get("Extended", False))
+    repo = None
+    if d.get("Repository"):
+        repo = Repository(family=d["Repository"].get("Family", ""),
+                          release=d["Repository"].get("Release", ""))
+    return BlobInfo(
+        schema_version=d.get("SchemaVersion", SCHEMA_VERSION),
+        digest=d.get("Digest", ""),
+        diff_id=d.get("DiffID", ""),
+        os=os_,
+        repository=repo,
+        package_infos=[
+            PackageInfo(file_path=pi.get("FilePath", ""),
+                        packages=[pkg(p) for p in
+                                  pi.get("Packages") or []])
+            for pi in d.get("PackageInfos") or []],
+        applications=[
+            Application(type=ap.get("Type", ""),
+                        file_path=ap.get("FilePath", ""),
+                        libraries=[pkg(p) for p in
+                                   ap.get("Libraries") or []])
+            for ap in d.get("Applications") or []],
+        config_files=[
+            ConfigFile(type=cf.get("Type", ""),
+                       file_path=cf.get("FilePath", ""),
+                       content=(cf.get("Content") or "").encode())
+            for cf in d.get("ConfigFiles") or []],
+        secrets=[
+            Secret(file_path=s.get("FilePath", ""),
+                   findings=[finding(f) for f in
+                             s.get("Findings") or []])
+            for s in d.get("Secrets") or []],
+        opaque_dirs=d.get("OpaqueDirs") or [],
+        whiteout_files=d.get("WhiteoutFiles") or [],
+        system_files=d.get("SystemFiles") or [],
+    )
+
+
+def _artifact_from_dict(d: dict) -> ArtifactInfo:
+    return ArtifactInfo(
+        schema_version=d.get("SchemaVersion", SCHEMA_VERSION),
+        architecture=d.get("Architecture", ""),
+        created=d.get("Created", ""),
+        docker_version=d.get("DockerVersion", ""),
+        os=d.get("OS", ""),
+        history_packages=d.get("HistoryPackages") or [],
+    )

@@ -1,0 +1,158 @@
+"""Container image loading: docker-save and OCI-layout tarballs/dirs.
+
+Reference: pkg/fanal/image (archive.go + daemon/registry fallbacks).
+This environment is zero-egress, so the supported sources are local:
+docker-save tar (manifest.json), OCI image layout (index.json), or a
+directory in OCI layout form. Registry/daemon resolution plugs in
+behind the same ImageSource interface later.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class LayerRef:
+    diff_id: str                    # sha256 of the UNCOMPRESSED tar
+    open: Callable                  # () -> tarfile.TarFile
+
+
+@dataclass
+class ImageSource:
+    name: str
+    id: str                         # image config digest
+    config: dict                    # parsed image config JSON
+    layers: list = field(default_factory=list)    # [LayerRef]
+    repo_tags: list = field(default_factory=list)
+    repo_digests: list = field(default_factory=list)
+
+    @property
+    def diff_ids(self) -> list:
+        return [la.diff_id for la in self.layers]
+
+
+def load_image(path: str, name: Optional[str] = None) -> ImageSource:
+    """Sniff + load a docker-save tar / OCI layout tar / OCI dir."""
+    name = name or path
+    if os.path.isdir(path):
+        return _load_oci_dir(path, name)
+    with tarfile.open(path) as tf:
+        names = tf.getnames()
+        if "manifest.json" in names:
+            return _load_docker_save(path, name)
+        if "index.json" in names:
+            return _load_oci_tar(path, name)
+    raise ValueError(f"unrecognized image archive: {path}")
+
+
+# --- docker save format ---
+
+def _load_docker_save(path: str, name: str) -> ImageSource:
+    with tarfile.open(path) as tf:
+        manifest = json.loads(_read(tf, "manifest.json"))[0]
+        config_name = manifest["Config"]
+        config = json.loads(_read(tf, config_name))
+    diff_ids = config.get("rootfs", {}).get("diff_ids", [])
+    layer_paths = manifest.get("Layers", [])
+    layers = [
+        LayerRef(diff_id=d, open=_tar_member_opener(path, lp))
+        for d, lp in zip(diff_ids, layer_paths)
+    ]
+    image_id = "sha256:" + hashlib.sha256(
+        _canon_json(config)).hexdigest()
+    return ImageSource(
+        name=name, id=image_id, config=config, layers=layers,
+        repo_tags=manifest.get("RepoTags") or [],
+    )
+
+
+# --- OCI layout ---
+
+def _load_oci_tar(path: str, name: str) -> ImageSource:
+    with tarfile.open(path) as tf:
+        index = json.loads(_read(tf, "index.json"))
+        read = lambda p: _read(tf, p)       # noqa: E731
+        return _load_oci(index, read, name,
+                         opener=lambda p: _tar_member_opener(path, p))
+
+
+def _load_oci_dir(path: str, name: str) -> ImageSource:
+    with open(os.path.join(path, "index.json")) as f:
+        index = json.load(f)
+
+    def read(rel: str) -> bytes:
+        with open(os.path.join(path, rel), "rb") as f:
+            return f.read()
+
+    def opener(rel: str) -> Callable:
+        return lambda: _open_layer_file(os.path.join(path, rel))
+
+    return _load_oci(index, read, name, opener)
+
+
+def _load_oci(index: dict, read: Callable, name: str,
+              opener: Callable) -> ImageSource:
+    manifests = index.get("manifests", [])
+    if not manifests:
+        raise ValueError("empty OCI index")
+    mdigest = manifests[0]["digest"]
+    manifest = json.loads(read(_blob_path(mdigest)))
+    if manifest.get("manifests"):        # nested index (multi-arch)
+        mdigest = manifest["manifests"][0]["digest"]
+        manifest = json.loads(read(_blob_path(mdigest)))
+    cdigest = manifest["config"]["digest"]
+    config = json.loads(read(_blob_path(cdigest)))
+    diff_ids = config.get("rootfs", {}).get("diff_ids", [])
+    layers = []
+    for d, desc in zip(diff_ids, manifest.get("layers", [])):
+        layers.append(LayerRef(
+            diff_id=d, open=opener(_blob_path(desc["digest"]))))
+    return ImageSource(name=name, id=cdigest, config=config,
+                       layers=layers)
+
+
+def _blob_path(digest: str) -> str:
+    algo, _, hex_ = digest.partition(":")
+    return f"blobs/{algo}/{hex_}"
+
+
+# --- helpers ---
+
+def _read(tf: tarfile.TarFile, member: str) -> bytes:
+    f = tf.extractfile(member)
+    if f is None:
+        raise ValueError(f"missing member {member}")
+    return f.read()
+
+
+def _canon_json(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":"),
+                      sort_keys=True).encode()
+
+
+def _tar_member_opener(archive_path: str, member: str) -> Callable:
+    def open_layer() -> tarfile.TarFile:
+        outer = tarfile.open(archive_path)
+        f = outer.extractfile(member)
+        data = f.read()
+        outer.close()
+        if data[:2] == b"\x1f\x8b":
+            data = gzip.decompress(data)
+        return tarfile.open(fileobj=io.BytesIO(data))
+    return open_layer
+
+
+def _open_layer_file(full: str) -> tarfile.TarFile:
+    with open(full, "rb") as f:
+        data = f.read()
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+    return tarfile.open(fileobj=io.BytesIO(data))

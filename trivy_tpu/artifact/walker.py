@@ -1,0 +1,94 @@
+"""Layer-tar and filesystem walkers (reference: pkg/fanal/walker).
+
+Tar walker semantics (tar.go:33-125): iterate entries, collect
+whiteout files (``.wh.<name>``) and opaque dirs (``.wh..wh..opq``),
+skip non-regular files; paths are cleaned, no leading slash.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+import tarfile
+from typing import Callable
+
+WH_PREFIX = ".wh."
+OPQ = ".wh..wh..opq"
+
+SKIP_SYSTEM_DIRS = ["proc", "sys", "dev"]
+
+
+def collect_layer_tar(tf: tarfile.TarFile) -> tuple:
+    """Eagerly walk a layer tar: ([(path, size, read_fn)], opq_dirs,
+    wh_files)."""
+    files = []
+    opq_dirs: list = []
+    wh_files: list = []
+    for member in tf:
+        # strip the leading "./" / "/" PREFIX only — lstrip would eat
+        # the dot of dotfiles (./.env → env) and break .wh. detection
+        path = posixpath.normpath(member.name)
+        if path.startswith("/"):
+            path = path.lstrip("/")
+        if not path or path == ".":
+            continue
+        file_dir, file_name = posixpath.split(path)
+        if file_name == OPQ:
+            opq_dirs.append(file_dir)
+            continue
+        if file_name.startswith(WH_PREFIX):
+            wh_files.append(posixpath.join(
+                file_dir, file_name[len(WH_PREFIX):]))
+            continue
+        if not member.isreg():
+            continue
+        if _skip_system(path):
+            continue
+        files.append((path, member.size,
+                      _tar_reader(tf, member)))
+    return files, opq_dirs, wh_files
+
+
+def _tar_reader(tf: tarfile.TarFile, member) -> Callable:
+    def read() -> bytes:
+        f = tf.extractfile(member)
+        return f.read() if f is not None else b""
+    return read
+
+
+def _skip_system(path: str) -> bool:
+    top = path.split("/", 1)[0]
+    return top in SKIP_SYSTEM_DIRS
+
+
+def walk_fs(root: str, skip_dirs: list = (),
+            skip_files: list = ()) -> list:
+    """Directory walk → [(rel_path, size, read_fn)] (reference:
+    walker/fs.go; shared skip logic walk.go:47-62)."""
+    out = []
+    skip_dirs = set(skip_dirs)
+    skip_files = set(skip_files)
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+        if rel_dir == ".":
+            rel_dir = ""
+        dirnames[:] = [
+            d for d in dirnames
+            if posixpath.join(rel_dir, d) not in skip_dirs]
+        for name in sorted(filenames):
+            rel = posixpath.join(rel_dir, name)
+            if rel in skip_files:
+                continue
+            full = os.path.join(dirpath, name)
+            if not os.path.isfile(full) or os.path.islink(full):
+                continue
+            size = os.path.getsize(full)
+            out.append((rel, size, _file_reader(full)))
+    return out
+
+
+def _file_reader(full: str) -> Callable:
+    def read() -> bytes:
+        with open(full, "rb") as f:
+            return f.read()
+    return read

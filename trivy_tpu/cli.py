@@ -1,0 +1,230 @@
+"""Command-line interface (reference: pkg/commands/app.go).
+
+Subcommands mirror the reference's cobra tree: image, filesystem
+(alias fs), rootfs, sbom, server, version — flags follow the same
+names so invocations port over (``--severity``, ``--security-checks``,
+``--format``, ``--ignore-unfixed``, ``--skip-dirs`` …), plus
+``--backend tpu|cpu|cpu-ref`` selecting the kernel dispatch path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from tarfile import TarError as tarfile_error
+
+from . import __version__
+from .artifact import (ArtifactOption, FSCache, ImageArtifact,
+                       LocalFSArtifact, load_image)
+from .db import AdvisoryStore, load_fixtures
+from .report import write_report
+from .scan import LocalScanner, ScanTarget, filter_results
+from .scan.filter import load_ignore_file
+from .types import (Metadata, Report, ScanOptions, Severity,
+                    SEVERITIES)
+
+DEFAULT_SEVERITIES = "UNKNOWN,LOW,MEDIUM,HIGH,CRITICAL"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="trivy-tpu",
+        description="TPU-native security scanner")
+    p.add_argument("--version", action="version",
+                   version=f"trivy-tpu {__version__}")
+    p.add_argument("--cache-dir",
+                   default=os.path.join(
+                       os.path.expanduser("~"), ".cache", "trivy-tpu"))
+    p.add_argument("--quiet", "-q", action="store_true")
+    p.add_argument("--debug", "-d", action="store_true")
+    sub = p.add_subparsers(dest="command")
+
+    def scan_flags(sp):
+        sp.add_argument("--cache-dir",
+                        default=os.path.join(
+                            os.path.expanduser("~"), ".cache",
+                            "trivy-tpu"))
+        sp.add_argument("--severity", "-s", default=DEFAULT_SEVERITIES)
+        sp.add_argument("--security-checks", default="vuln,secret")
+        sp.add_argument("--vuln-type", default="os,library")
+        sp.add_argument("--format", "-f", default="table",
+                        choices=["table", "json"])
+        sp.add_argument("--output", "-o", default="")
+        sp.add_argument("--ignore-unfixed", action="store_true")
+        sp.add_argument("--ignorefile", default=".trivyignore")
+        sp.add_argument("--exit-code", type=int, default=0)
+        sp.add_argument("--skip-dirs", default="")
+        sp.add_argument("--skip-files", default="")
+        sp.add_argument("--list-all-pkgs", action="store_true")
+        sp.add_argument("--backend", default="tpu",
+                        choices=["tpu", "cpu", "cpu-ref"])
+        sp.add_argument("--db-fixtures", default="",
+                        help="comma-separated advisory fixture YAMLs")
+        sp.add_argument("--secret-config", default="trivy-secret.yaml")
+        sp.add_argument("--no-cache", action="store_true")
+
+    img = sub.add_parser("image", help="scan a container image "
+                         "(tarball or OCI layout)")
+    img.add_argument("--input", default="",
+                     help="image tarball path (docker save / OCI)")
+    img.add_argument("target", nargs="?", default="")
+    scan_flags(img)
+
+    fs = sub.add_parser("filesystem", aliases=["fs"],
+                        help="scan a local directory")
+    fs.add_argument("target")
+    scan_flags(fs)
+
+    rootfs = sub.add_parser("rootfs", help="scan an unpacked root "
+                            "filesystem")
+    rootfs.add_argument("target")
+    scan_flags(rootfs)
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command in (None, "version"):
+        print(f"trivy-tpu {__version__}")
+        return 0
+    if args.command in ("image",):
+        return run_image(args)
+    if args.command in ("filesystem", "fs", "rootfs"):
+        return run_fs(args)
+    return 2
+
+
+def _severities(arg: str) -> list:
+    return [Severity.parse(s) for s in arg.split(",") if s.strip()]
+
+
+def _store(args) -> AdvisoryStore:
+    store = AdvisoryStore()
+    if args.db_fixtures:
+        load_fixtures([p for p in args.db_fixtures.split(",") if p],
+                      store)
+    return store
+
+
+def _artifact_option(args) -> ArtifactOption:
+    from .secret.batch import BatchSecretScanner
+    from .secret.model import load_config
+    from .secret.scanner import new_scanner
+
+    checks = args.security_checks.split(",")
+    scanner = None
+    if "secret" in checks:
+        cpu = new_scanner(load_config(args.secret_config))
+        backend = "cpu-ref" if args.backend == "cpu-ref" else "tpu"
+        scanner = BatchSecretScanner(scanner=cpu, backend=backend)
+    return ArtifactOption(
+        skip_dirs=[d for d in args.skip_dirs.split(",") if d],
+        skip_files=[f for f in args.skip_files.split(",") if f],
+        secret_scanner=scanner,
+        scan_secrets="secret" in checks,
+    )
+
+
+def _scan_options(args) -> ScanOptions:
+    return ScanOptions(
+        vuln_type=[v for v in args.vuln_type.split(",") if v],
+        security_checks=[c for c in
+                         args.security_checks.split(",") if c],
+        list_all_packages=args.list_all_pkgs,
+        backend="cpu-ref" if args.backend == "cpu-ref" else args.backend,
+    )
+
+
+def _finish(args, report: Report) -> int:
+    results = filter_results(
+        report.results, _severities(args.severity),
+        ignore_unfixed=args.ignore_unfixed,
+        ignored_ids=load_ignore_file(args.ignorefile))
+    report.results = [r for r in results if not r.empty()]
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        write_report(report, fmt=args.format, output=out,
+                     severities=[str(s) for s in
+                                 _severities(args.severity)])
+    finally:
+        if args.output:
+            out.close()
+    if args.exit_code and any(r.failed() for r in report.results):
+        return args.exit_code
+    return 0
+
+
+def _cache(args):
+    from .artifact.cache import MemoryCache
+    if args.no_cache:
+        return MemoryCache()
+    return FSCache(args.cache_dir)
+
+
+def run_image(args) -> int:
+    path = args.input or args.target
+    if not path:
+        print("error: image target or --input required",
+              file=sys.stderr)
+        return 2
+    try:
+        image = load_image(path, name=args.target or path)
+    except (OSError, ValueError, tarfile_error) as e:
+        print(f"error: failed to load image {path!r}: {e}",
+              file=sys.stderr)
+        return 1
+    cache = _cache(args)
+    artifact = ImageArtifact(image, cache,
+                             option=_artifact_option(args))
+    ref = artifact.inspect()
+
+    scanner = LocalScanner(cache, _store(args))
+    results, os_found = scanner.scan(
+        ScanTarget(name=ref.name, artifact_id=ref.id,
+                   blob_ids=ref.blob_ids),
+        _scan_options(args))
+
+    report = Report(
+        artifact_name=ref.name,
+        artifact_type="container_image",
+        metadata=Metadata(
+            os=os_found,
+            image_id=ref.image_metadata.id,
+            diff_ids=ref.image_metadata.diff_ids,
+            repo_tags=ref.image_metadata.repo_tags,
+            repo_digests=ref.image_metadata.repo_digests,
+            image_config=ref.image_metadata.image_config,
+        ),
+        results=results,
+    )
+    return _finish(args, report)
+
+
+def run_fs(args) -> int:
+    if not os.path.isdir(args.target):
+        print(f"error: no such directory: {args.target}",
+              file=sys.stderr)
+        return 1
+    cache = _cache(args)
+    artifact = LocalFSArtifact(args.target, cache,
+                               option=_artifact_option(args))
+    ref = artifact.inspect()
+    scanner = LocalScanner(cache, _store(args))
+    results, os_found = scanner.scan(
+        ScanTarget(name=ref.name, artifact_id=ref.id,
+                   blob_ids=ref.blob_ids),
+        _scan_options(args))
+    report = Report(
+        artifact_name=args.target,
+        artifact_type="filesystem",
+        metadata=Metadata(os=os_found),
+        results=results,
+    )
+    return _finish(args, report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

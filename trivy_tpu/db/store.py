@@ -1,0 +1,140 @@
+"""In-memory advisory store with trivy-db access semantics.
+
+API mirrors the reference's ``db.Config``: ``get_advisories(prefix,
+pkg_name)`` scans every bucket whose name starts with the prefix
+(driver.go:83-91), ``get(bucket, pkg_name)`` reads one bucket
+(ospkg drivers), ``get_vulnerability(id)`` reads the detail record
+(pkg/vulnerability/vulnerability.go:44)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types import DataSource
+
+
+@dataclass
+class Advisory:
+    """trivy-db types.Advisory — only fields the detectors consume."""
+
+    vulnerability_id: str = ""
+    fixed_version: str = ""
+    affected_version: str = ""      # Alpine "introduced in"
+    vulnerable_versions: list = field(default_factory=list)
+    patched_versions: list = field(default_factory=list)
+    unaffected_versions: list = field(default_factory=list)
+    arches: list = field(default_factory=list)
+    severity: int = 0               # per-source severity enum value
+    vendor_ids: list = field(default_factory=list)
+    data_source: Optional[DataSource] = None
+
+    @classmethod
+    def from_dict(cls, vuln_id: str, d: dict) -> "Advisory":
+        ds = d.get("DataSource")
+        return cls(
+            vulnerability_id=vuln_id,
+            fixed_version=d.get("FixedVersion", ""),
+            affected_version=d.get("AffectedVersion", ""),
+            vulnerable_versions=list(d.get("VulnerableVersions") or []),
+            patched_versions=list(d.get("PatchedVersions") or []),
+            unaffected_versions=list(d.get("UnaffectedVersions") or []),
+            arches=list(d.get("Arches") or []),
+            severity=int(d.get("Severity", 0) or 0),
+            vendor_ids=list(d.get("VendorIDs") or []),
+            data_source=DataSource(
+                id=ds.get("ID", ""), name=ds.get("Name", ""),
+                url=ds.get("URL", "")) if ds else None,
+        )
+
+
+@dataclass
+class VulnerabilityDetail:
+    """trivy-db ``vulnerability`` bucket record."""
+
+    id: str = ""
+    title: str = ""
+    description: str = ""
+    severity: str = ""
+    vendor_severity: dict = field(default_factory=dict)
+    cvss: dict = field(default_factory=dict)
+    cwe_ids: list = field(default_factory=list)
+    references: list = field(default_factory=list)
+    published_date: str = ""
+    last_modified_date: str = ""
+
+    @classmethod
+    def from_dict(cls, vuln_id: str, d: dict) -> "VulnerabilityDetail":
+        sev = d.get("Severity", "")
+        if isinstance(sev, int):
+            from ..types import SEVERITIES
+            sev = str(SEVERITIES[sev]) if 0 <= sev < 5 else ""
+        return cls(
+            id=vuln_id,
+            title=d.get("Title", ""),
+            description=d.get("Description", ""),
+            severity=sev,
+            vendor_severity=dict(d.get("VendorSeverity") or {}),
+            cvss=dict(d.get("CVSS") or {}),
+            cwe_ids=list(d.get("CweIDs") or []),
+            references=list(d.get("References") or []),
+            published_date=d.get("PublishedDate", ""),
+            last_modified_date=d.get("LastModifiedDate", ""),
+        )
+
+
+class AdvisoryStore:
+    """bucket name → package name → {cve id → advisory dict}."""
+
+    def __init__(self):
+        self.buckets: dict = {}
+        self.vulnerabilities: dict = {}
+        self.data_sources: dict = {}
+
+    # --- writes ---
+
+    def put_advisory(self, bucket: str, pkg: str, vuln_id: str,
+                     value: dict) -> None:
+        self.buckets.setdefault(bucket, {}) \
+            .setdefault(pkg, {})[vuln_id] = value
+
+    def put_vulnerability(self, vuln_id: str, value: dict) -> None:
+        self.vulnerabilities[vuln_id] = value
+
+    def put_data_source(self, bucket: str, value: dict) -> None:
+        self.data_sources[bucket] = value
+
+    # --- reads (db.Config semantics) ---
+
+    def get(self, bucket: str, pkg_name: str) -> list:
+        """Advisories for one package in one bucket."""
+        out = []
+        for vid, v in (self.buckets.get(bucket, {})
+                       .get(pkg_name, {})).items():
+            adv = Advisory.from_dict(vid, v)
+            if adv.data_source is None:
+                adv.data_source = self._bucket_source(bucket)
+            out.append(adv)
+        return out
+
+    def get_advisories(self, prefix: str, pkg_name: str) -> list:
+        """Prefix scan over buckets (e.g. ``pip::``) — driver.go:83."""
+        out = []
+        for bucket in sorted(self.buckets):
+            if bucket.startswith(prefix):
+                out.extend(self.get(bucket, pkg_name))
+        return out
+
+    def get_vulnerability(self, vuln_id: str)\
+            -> Optional[VulnerabilityDetail]:
+        v = self.vulnerabilities.get(vuln_id)
+        if v is None:
+            return None
+        return VulnerabilityDetail.from_dict(vuln_id, v)
+
+    def _bucket_source(self, bucket: str) -> Optional[DataSource]:
+        d = self.data_sources.get(bucket)
+        if not d:
+            return None
+        return DataSource(id=d.get("ID", ""), name=d.get("Name", ""),
+                          url=d.get("URL", ""))

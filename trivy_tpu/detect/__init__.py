@@ -1,0 +1,12 @@
+"""Detectors: package lists × advisory store → DetectedVulnerability.
+
+Reference: pkg/detector/library (ecosystem drivers) and
+pkg/detector/ospkg (distro drivers). Comparison work batches onto the
+TPU via trivy_tpu.detect.batch; per-package host paths remain for
+exactness checks and small scans.
+"""
+
+from .library import LibraryDriver, new_library_driver
+from .ospkg import ospkg_detect
+
+__all__ = ["LibraryDriver", "new_library_driver", "ospkg_detect"]

@@ -1,0 +1,208 @@
+"""Batch vulnerability detection: rank-encode versions, compile
+constraints to intervals, one TPU dispatch for every (package,
+advisory) pair across every ecosystem in the batch.
+
+Parity: results are identical to the host drivers (library.py /
+ospkg/drivers.py) — guaranteed because interval compilation is exact
+over the finite rank universe, pairs whose constraints exceed
+MAX_INTERVALS or fail to parse fall back to the host path, and the
+doubled rank space captures bound exclusivity exactly.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..ops.intervals import (MAX_INTERVALS, NEG_INF, POS_INF,
+                             interval_hits, interval_hits_host)
+from ..utils import get_logger
+from ..vercmp import get_comparer
+from ..vercmp.base import Interval
+
+log = get_logger("detect.batch")
+
+
+@dataclass
+class PairJob:
+    """One (package, advisory) candidate pair after the name join."""
+
+    grammar: str
+    pkg_version: str
+    vulnerable: list = field(default_factory=list)  # constraint strings
+    patched: list = field(default_factory=list)
+    unaffected: list = field(default_factory=list)
+    payload: object = None          # opaque — returned with hits
+    # ospkg-style single bounds:
+    fixed_version: str = ""
+    affected_version: str = ""
+    report_unfixed: bool = True
+    kind: str = "library"           # "library" | "ospkg"
+
+
+class _RankSpace:
+    """Per-grammar rank universe over the batch's version strings."""
+
+    def __init__(self, grammar: str):
+        self.comparer = get_comparer(grammar)
+        self.keys: dict = {}
+        self.extra: list = []           # constraint bound keys
+
+    def key(self, version: str):
+        if version not in self.keys:
+            self.keys[version] = self.comparer.parse(version)
+        return self.keys[version]
+
+    def add_key(self, key) -> None:
+        self.extra.append(key)
+
+    def finalize(self):
+        self.sorted_keys = sorted(
+            set(self.keys.values()) | set(self.extra))
+
+    def rank(self, key) -> int:
+        return 2 * bisect_left(self.sorted_keys, key)
+
+    def encode(self, iv: Interval) -> tuple:
+        lo = NEG_INF if iv.lo is None else \
+            self.rank(iv.lo) + (0 if iv.lo_incl else 1)
+        hi = POS_INF if iv.hi is None else \
+            self.rank(iv.hi) - (0 if iv.hi_incl else 1)
+        return lo, hi
+
+
+def detect_pairs(jobs: list, backend: str = "tpu") -> list:
+    """Returns payloads of vulnerable pairs, batch order preserved."""
+    if not jobs:
+        return []
+    spaces: dict = {}
+    rows = []          # (job, pkg_key, vuln_ivs, sec_ivs, flags)
+    host_jobs = []     # fallback: (index, job)
+
+    for job in jobs:
+        sp = spaces.setdefault(job.grammar, _RankSpace(job.grammar))
+        try:
+            pkg_key = sp.key(job.pkg_version)
+        except ValueError as e:
+            log.debug("package version parse error: %s", e)
+            continue                      # reference: skip the package
+        try:
+            vuln_ivs, sec_ivs, flags = _compile(job, sp)
+        except _HostFallback:
+            host_jobs.append(job)
+            continue
+        except ValueError as e:
+            log.debug("constraint error: %s", e)
+            continue                      # reference: warn + not vuln
+        if flags is None:
+            continue                      # statically not vulnerable
+        rows.append((job, pkg_key, vuln_ivs, sec_ivs, flags))
+
+    out = []
+    if rows:
+        for sp in spaces.values():
+            sp.finalize()
+        P = len(rows)
+        pkg_rank = np.zeros(P, np.int32)
+        v_lo = np.full((P, MAX_INTERVALS), POS_INF, np.int32)
+        v_hi = np.full((P, MAX_INTERVALS), NEG_INF, np.int32)
+        s_lo = np.full((P, MAX_INTERVALS), POS_INF, np.int32)
+        s_hi = np.full((P, MAX_INTERVALS), NEG_INF, np.int32)
+        flags_arr = np.zeros(P, np.int32)
+        for i, (job, pkg_key, vuln_ivs, sec_ivs, flags) in \
+                enumerate(rows):
+            sp = spaces[job.grammar]
+            pkg_rank[i] = sp.rank(pkg_key)
+            for j, iv in enumerate(vuln_ivs):
+                v_lo[i, j], v_hi[i, j] = sp.encode(iv)
+            for j, iv in enumerate(sec_ivs):
+                s_lo[i, j], s_hi[i, j] = sp.encode(iv)
+            flags_arr[i] = flags
+        fn = interval_hits_host if backend == "cpu-ref" else \
+            _device_hits
+        hits = np.asarray(fn(pkg_rank, v_lo, v_hi, s_lo, s_hi,
+                             flags_arr))
+        out.extend(rows[i][0].payload for i in np.nonzero(hits)[0])
+
+    # host fallback pairs: exact per-pair evaluation
+    for job in host_jobs:
+        if _host_eval(job):
+            out.append(job.payload)
+    return out
+
+
+def _device_hits(*arrs):
+    import jax.numpy as jnp
+    return interval_hits(*(jnp.asarray(a) for a in arrs))
+
+
+class _HostFallback(Exception):
+    pass
+
+
+def _compile(job: PairJob, sp: _RankSpace):
+    """job → (vuln intervals, secure intervals, flags) or None when
+    statically not vulnerable. Raises _HostFallback on complexity."""
+    if job.kind == "ospkg":
+        return _compile_ospkg(job, sp)
+
+    flags = 0
+    if any(v == "" for v in list(job.vulnerable) + list(job.patched)):
+        return [], [], 2                  # force-vulnerable
+
+    vuln_ivs: list = []
+    if job.vulnerable:
+        flags |= 1
+        for constraint in " || ".join(job.vulnerable).split("||"):
+            if not constraint.strip():
+                raise ValueError("empty constraint alternative")
+            vuln_ivs.extend(
+                sp.comparer.constraint_intervals(constraint))
+    secure = list(job.patched) + list(job.unaffected)
+    sec_ivs: list = []
+    if secure:
+        flags |= 4
+        for constraint in " || ".join(secure).split("||"):
+            if not constraint.strip():
+                raise ValueError("empty constraint alternative")
+            sec_ivs.extend(
+                sp.comparer.constraint_intervals(constraint))
+    if len(vuln_ivs) > MAX_INTERVALS or len(sec_ivs) > MAX_INTERVALS:
+        raise _HostFallback
+    for iv in vuln_ivs + sec_ivs:
+        _intern_bounds(iv, sp)
+    return vuln_ivs, sec_ivs, flags
+
+
+def _compile_ospkg(job: PairJob, sp: _RankSpace):
+    """OS advisory → vulnerable interval [affected, fixed)."""
+    lo = None
+    if job.affected_version:
+        lo = sp.key(job.affected_version)    # may raise ValueError
+    if job.fixed_version == "":
+        if not job.report_unfixed:
+            return [], [], None       # statically not vulnerable
+        iv = Interval(lo=lo)
+    else:
+        iv = Interval(lo=lo, hi=sp.key(job.fixed_version),
+                      hi_incl=False)
+    return [iv], [], 1
+
+
+def _intern_bounds(iv: Interval, sp: _RankSpace) -> None:
+    """Constraint bounds are parsed keys — register them in the rank
+    universe so ``finalize`` covers them."""
+    if iv.lo is not None:
+        sp.add_key(iv.lo)
+    if iv.hi is not None:
+        sp.add_key(iv.hi)
+
+
+def _host_eval(job: PairJob) -> bool:
+    from ..vercmp.base import is_vulnerable
+    comparer = get_comparer(job.grammar)
+    return is_vulnerable(comparer, job.pkg_version, job.vulnerable,
+                         job.patched, job.unaffected)

@@ -1,0 +1,93 @@
+"""Vulnerability enrichment — severity precedence + primary URL.
+
+Reference: pkg/vulnerability/vulnerability.go FillInfo (44-93):
+package-specific vendor severity (SeveritySource set by the detector)
+wins; else the datasource's vendor severity; else NVD; else the record
+severity; else UNKNOWN. Primary URL by id prefix, then per-source
+reference prefixes (16-24, 96-).
+"""
+
+from __future__ import annotations
+
+from ..types import Vulnerability
+from ..types.common import SEVERITIES
+from ..utils import get_logger
+
+log = get_logger("detect.enrich")
+
+_PRIMARY_URL_PREFIXES = {
+    "debian": ["http://www.debian.org", "https://www.debian.org"],
+    "ubuntu": ["http://www.ubuntu.com", "https://usn.ubuntu.com"],
+    "redhat": ["https://access.redhat.com"],
+    "suse-cvrf": ["http://lists.opensuse.org",
+                  "https://lists.opensuse.org"],
+    "oracle-oval": ["http://linux.oracle.com/errata",
+                    "https://linux.oracle.com/errata"],
+    "nodejs-security-wg": ["https://www.npmjs.com",
+                           "https://hackerone.com"],
+    "ruby-advisory-db": ["https://groups.google.com"],
+}
+
+
+def _sev_name(v) -> str:
+    if isinstance(v, int):
+        return str(SEVERITIES[v]) if 0 <= v < len(SEVERITIES) \
+            else "UNKNOWN"
+    return str(v)
+
+
+def fill_info(store, vulns: list) -> None:
+    """Mutates DetectedVulnerability list in place."""
+    for v in vulns:
+        detail = store.get_vulnerability(v.vulnerability_id)
+        if detail is None:
+            continue
+        source = v.data_source.id if v.data_source else ""
+        severity, severity_source = _vendor_severity(detail, source)
+        if v.severity_source:
+            # package-specific severity from the detector wins
+            severity = v.vulnerability.severity or "UNKNOWN"
+            severity_source = v.severity_source
+
+        v.vulnerability = Vulnerability(
+            title=detail.title,
+            description=detail.description,
+            severity=severity,
+            cwe_ids=detail.cwe_ids,
+            vendor_severity={k: _sev_name(s) for k, s in
+                             detail.vendor_severity.items()},
+            cvss=detail.cvss,
+            references=detail.references,
+            published_date=detail.published_date or None,
+            last_modified_date=detail.last_modified_date or None,
+        )
+        v.severity_source = severity_source
+        v.primary_url = _primary_url(v.vulnerability_id,
+                                     detail.references, source)
+
+
+def _vendor_severity(detail, source: str) -> tuple:
+    vs = detail.vendor_severity
+    if source in vs:
+        return _sev_name(vs[source]), source
+    if "nvd" in vs:
+        return _sev_name(vs["nvd"]), "nvd"
+    if not detail.severity:
+        return "UNKNOWN", ""
+    return detail.severity, ""
+
+
+def _primary_url(vuln_id: str, refs: list, source: str) -> str:
+    if vuln_id.startswith("CVE-"):
+        return "https://avd.aquasec.com/nvd/" + vuln_id.lower()
+    if vuln_id.startswith("RUSTSEC-"):
+        return "https://osv.dev/vulnerability/" + vuln_id
+    if vuln_id.startswith("GHSA-"):
+        return "https://github.com/advisories/" + vuln_id
+    if vuln_id.startswith("TEMP-"):
+        return "https://security-tracker.debian.org/tracker/" + vuln_id
+    for pre in _PRIMARY_URL_PREFIXES.get(source, []):
+        for ref in refs:
+            if ref.startswith(pre):
+                return ref
+    return ""

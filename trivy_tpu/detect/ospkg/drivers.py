@@ -1,0 +1,265 @@
+"""Distro drivers: bucket lookup + fixed-version comparison.
+
+Shapes mirror pkg/detector/ospkg/*: each driver knows its trivy-db
+bucket naming, version grammar, OS-version normalization, EOL table,
+and unfixed-advisory policy. Installed versions format as
+``[epoch:]version[-release]`` from the SOURCE package fields
+(pkg/scanner/utils/utils.go:15-28).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Optional
+
+from ...types import DetectedVulnerability, Vulnerability
+from ...types.common import SEVERITIES
+from ...utils import get_logger
+from ...vercmp import get_comparer
+
+log = get_logger("detect.ospkg")
+
+
+def format_version(epoch, version, release) -> str:
+    v = version or ""
+    if release:
+        v = f"{v}-{release}"
+    if epoch:
+        v = f"{epoch}:{v}"
+    return v
+
+
+def format_src_version(pkg) -> str:
+    return format_version(pkg.src_epoch, pkg.src_version,
+                          pkg.src_release)
+
+
+def _severity_name(value: int) -> str:
+    if 0 <= value < len(SEVERITIES):
+        return str(SEVERITIES[value])
+    return "UNKNOWN"
+
+
+@dataclass
+class Driver:
+    """One distro scanner. Subclasses/instances configure behavior."""
+
+    family: str
+    grammar: str
+    bucket_fmt: str                  # e.g. "alpine {ver}"
+    severity_source: str = ""        # set per-pkg severity when given
+    report_unfixed: bool = True
+    eol: dict = None                 # os_ver → date
+
+    # --- version normalization hooks ---
+
+    def normalize_ver(self, os_ver: str) -> str:
+        return os_ver
+
+    def bucket(self, os_ver: str, repo) -> str:
+        return self.bucket_fmt.format(ver=self.normalize_ver(os_ver))
+
+    def src_name(self, pkg) -> str:
+        return pkg.src_name or pkg.name
+
+    def installed(self, pkg) -> str:
+        return format_src_version(pkg)
+
+    # --- main loop (mirrors e.g. debian.go:85-140) ---
+
+    def detect(self, store, os_ver: str, repo, pkgs: list) -> list:
+        comparer = get_comparer(self.grammar)
+        bucket = self.bucket(os_ver, repo)
+        vulns = []
+        for pkg in pkgs:
+            installed = self.installed(pkg)
+            try:
+                installed_key = comparer.parse(installed)
+            except ValueError as e:
+                log.debug("installed version parse error: %s", e)
+                continue
+            for adv in store.get(bucket, self.src_name(pkg)):
+                if not self._is_vulnerable(comparer, installed_key,
+                                           adv):
+                    continue
+                v = DetectedVulnerability(
+                    vulnerability_id=adv.vulnerability_id,
+                    vendor_ids=adv.vendor_ids,
+                    pkg_id=pkg.id,
+                    pkg_name=pkg.name,
+                    installed_version=installed,
+                    fixed_version=adv.fixed_version,
+                    layer=pkg.layer,
+                    ref=pkg.ref,
+                    data_source=adv.data_source,
+                )
+                if self.severity_source and adv.severity:
+                    v.severity_source = self.severity_source
+                    v.vulnerability = Vulnerability(
+                        severity=_severity_name(adv.severity))
+                vulns.append(v)
+        return vulns
+
+    def _is_vulnerable(self, comparer, installed_key, adv) -> bool:
+        # Alpine AffectedVersion: version that introduced the vuln
+        if adv.affected_version:
+            try:
+                if comparer.parse(adv.affected_version)\
+                        > installed_key:
+                    return False
+            except ValueError as e:
+                log.debug("affected version parse error: %s", e)
+                return False
+        if adv.fixed_version == "":
+            return self.report_unfixed
+        try:
+            fixed_key = comparer.parse(adv.fixed_version)
+        except ValueError as e:
+            log.debug("fixed version parse error: %s", e)
+            return False
+        return installed_key < fixed_key
+
+    # --- support window ---
+
+    def is_supported(self, os_ver: str, now=None) -> bool:
+        if not self.eol:
+            return True
+        eol = self.eol.get(self.normalize_ver(os_ver))
+        if eol is None:
+            return True            # may be the latest version
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        return now.date() <= eol
+
+
+class _Alpine(Driver):
+    def normalize_ver(self, os_ver: str) -> str:
+        parts = os_ver.split(".")
+        if len(parts) > 2:
+            os_ver = ".".join(parts[:2])
+        return os_ver
+
+    def bucket(self, os_ver: str, repo) -> str:
+        stream = self.normalize_ver(os_ver)
+        repo_release = getattr(repo, "release", "") if repo else ""
+        if repo_release and stream != repo_release:
+            # prefer the repository release (alpine.go:96-104)
+            stream = repo_release
+        return self.bucket_fmt.format(ver=stream)
+
+
+class _MajorOnly(Driver):
+    def normalize_ver(self, os_ver: str) -> str:
+        return os_ver.split(".")[0]
+
+
+_D = datetime.date
+
+ALPINE_EOL = {
+    "2.0": _D(2012, 4, 1), "2.1": _D(2012, 11, 1),
+    "2.2": _D(2013, 5, 1), "2.3": _D(2013, 11, 1),
+    "2.4": _D(2014, 5, 1), "2.5": _D(2014, 11, 1),
+    "2.6": _D(2015, 5, 1), "2.7": _D(2015, 11, 1),
+    "3.0": _D(2016, 5, 1), "3.1": _D(2016, 11, 1),
+    "3.2": _D(2017, 5, 1), "3.3": _D(2017, 11, 1),
+    "3.4": _D(2018, 5, 1), "3.5": _D(2018, 11, 1),
+    "3.6": _D(2019, 5, 1), "3.7": _D(2019, 11, 1),
+    "3.8": _D(2020, 5, 1), "3.9": _D(2020, 11, 1),
+    "3.10": _D(2021, 5, 1), "3.11": _D(2021, 11, 1),
+    "3.12": _D(2022, 5, 1), "3.13": _D(2022, 11, 1),
+    "3.14": _D(2023, 5, 1), "3.15": _D(2023, 11, 1),
+    "3.16": _D(2024, 5, 23), "edge": _D(9999, 1, 1),
+}
+
+DEBIAN_EOL = {
+    "1.1": _D(1997, 6, 5), "1.2": _D(1998, 6, 5),
+    "1.3": _D(1999, 3, 9), "2.0": _D(2000, 3, 9),
+    "2.1": _D(2000, 10, 30), "2.2": _D(2003, 7, 30),
+    "3.0": _D(2006, 6, 30), "3.1": _D(2008, 3, 30),
+    "4.0": _D(2010, 2, 15), "5.0": _D(2012, 2, 6),
+    "6.0": _D(2016, 2, 29), "7": _D(2018, 5, 31),
+    "8": _D(2020, 6, 30), "9": _D(2022, 6, 30),
+    "10": _D(2024, 6, 30), "11": _D(2026, 8, 14),
+    "12": _D(3000, 1, 1),
+}
+
+UBUNTU_EOL = {
+    "4.10": _D(2006, 4, 30), "5.04": _D(2006, 10, 31),
+    "5.10": _D(2007, 4, 13), "6.06": _D(2011, 6, 1),
+    "6.10": _D(2008, 4, 25), "7.04": _D(2008, 10, 19),
+    "7.10": _D(2009, 4, 18), "8.04": _D(2013, 5, 9),
+    "8.10": _D(2010, 4, 30), "9.04": _D(2010, 10, 23),
+    "9.10": _D(2011, 4, 29), "10.04": _D(2015, 4, 29),
+    "10.10": _D(2012, 4, 10), "11.04": _D(2012, 10, 28),
+    "11.10": _D(2013, 5, 9), "12.04": _D(2019, 4, 26),
+    "12.10": _D(2014, 5, 16), "13.04": _D(2014, 1, 27),
+    "13.10": _D(2014, 7, 17), "14.04": _D(2022, 4, 25),
+    "14.10": _D(2015, 7, 23), "15.04": _D(2016, 1, 23),
+    "15.10": _D(2016, 7, 22), "16.04": _D(2024, 4, 21),
+    "16.10": _D(2017, 7, 20), "17.04": _D(2018, 1, 13),
+    "17.10": _D(2018, 7, 19), "18.04": _D(2028, 4, 26),
+    "18.10": _D(2019, 7, 18), "19.04": _D(2020, 1, 18),
+    "19.10": _D(2020, 7, 17), "20.04": _D(2030, 4, 23),
+    "20.10": _D(2021, 7, 22), "21.04": _D(2022, 1, 22),
+    "21.10": _D(2022, 7, 22), "22.04": _D(2032, 4, 23),
+    "22.10": _D(2023, 7, 20),
+}
+
+
+class _RedHat(Driver):
+    """Red Hat / CentOS (reference: pkg/detector/ospkg/redhat).
+
+    Partial: advisories come from the flat 'Red Hat' bucket keyed by
+    source package name; the reference additionally filters by CPE
+    content sets from buildinfo and handles modularity labels — those
+    refinements layer on when the Red Hat CPE table lands."""
+
+    def bucket(self, os_ver: str, repo) -> str:
+        return "Red Hat"
+
+
+DRIVERS = {
+    "alpine": _Alpine("alpine", "apk", "alpine {ver}",
+                      report_unfixed=True, eol=ALPINE_EOL),
+    "debian": _MajorOnly("debian", "deb", "debian {ver}",
+                         severity_source="debian",
+                         report_unfixed=True, eol=DEBIAN_EOL),
+    "ubuntu": Driver("ubuntu", "deb", "ubuntu {ver}",
+                     severity_source="ubuntu",
+                     report_unfixed=True, eol=UBUNTU_EOL),
+    "amazon": _MajorOnly("amazon", "rpm", "amazon linux {ver}",
+                         severity_source="amazon",
+                         report_unfixed=False),
+    "oracle": _MajorOnly("oracle", "rpm", "Oracle Linux {ver}",
+                         report_unfixed=False),
+    "alma": _MajorOnly("alma", "rpm", "alma {ver}",
+                       severity_source="alma", report_unfixed=False),
+    "rocky": _MajorOnly("rocky", "rpm", "rocky {ver}",
+                        severity_source="rocky", report_unfixed=False),
+    "redhat": _RedHat("redhat", "rpm", "Red Hat",
+                      severity_source="redhat", report_unfixed=True),
+    "centos": _RedHat("centos", "rpm", "Red Hat",
+                      severity_source="redhat", report_unfixed=True),
+    "cbl-mariner": Driver("cbl-mariner", "rpm", "CBL-Mariner {ver}",
+                          report_unfixed=True),
+    "photon": Driver("photon", "rpm", "Photon OS {ver}",
+                     severity_source="photon", report_unfixed=True),
+    "opensuse.leap": Driver("opensuse.leap", "rpm",
+                            "openSUSE Leap {ver}",
+                            report_unfixed=False),
+    "suse linux enterprise server": Driver(
+        "suse linux enterprise server", "rpm",
+        "SUSE Linux Enterprise {ver}", report_unfixed=False),
+}
+
+
+def ospkg_detect(family: str, os_ver: str, repo, pkgs: list,
+                 store) -> tuple:
+    """(vulns, eosl) for one OS package set. Raises KeyError for
+    unsupported families (detect.go:66-69)."""
+    driver = DRIVERS.get(family.lower())
+    if driver is None:
+        raise KeyError(f"unsupported os family: {family}")
+    vulns = driver.detect(store, os_ver, repo, pkgs)
+    eosl = not driver.is_supported(os_ver)
+    return vulns, eosl

@@ -1,0 +1,66 @@
+"""Vectorized version-interval membership — the package→CVE kernel.
+
+The reference compares versions pair-by-pair in Go (compare.go:21-56,
+ospkg drivers). TPU re-design: the host parses every version string
+once per batch, ranks them within their grammar's total order, and
+compiles each advisory's constraints into ≤M half-open intervals in a
+DOUBLED rank space (bound = 2·rank, exclusivity = ±1) — after which
+"is version v vulnerable to advisory a" is pure int32 compares over a
+[P, M] table, identical for every grammar and for both the library
+and OS-package detectors.
+
+Semantics bits per pair (flags):
+  bit0 has_vulnerable_constraints
+  bit1 force (empty-string constraint ⇒ always vulnerable)
+  bit2 has_secure_constraints (patched + unaffected)
+
+out = force | (has_vuln ? vuln_any & (has_sec ? ¬sec_any : 1)
+                        : (has_sec ? ¬sec_any : 0))
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_INTERVALS = 4          # per side; host falls back past this
+NEG_INF = -(2 ** 31) + 1
+POS_INF = 2 ** 31 - 1
+
+
+def interval_hits_impl(pkg_rank: jax.Array, vuln_lo: jax.Array,
+                       vuln_hi: jax.Array, sec_lo: jax.Array,
+                       sec_hi: jax.Array,
+                       flags: jax.Array) -> jax.Array:
+    """[P] ranks × [P, M] interval tables → [P] bool vulnerable."""
+    r = pkg_rank[:, None]
+    vuln_any = ((vuln_lo <= r) & (r <= vuln_hi)).any(axis=1)
+    sec_any = ((sec_lo <= r) & (r <= sec_hi)).any(axis=1)
+
+    has_vuln = (flags & 1).astype(bool)
+    force = (flags & 2).astype(bool)
+    has_sec = (flags & 4).astype(bool)
+
+    not_sec = jnp.where(has_sec, ~sec_any, True)
+    with_vuln = vuln_any & not_sec
+    without_vuln = jnp.where(has_sec, ~sec_any, False)
+    return force | jnp.where(has_vuln, with_vuln, without_vuln)
+
+
+interval_hits = jax.jit(interval_hits_impl)
+
+
+def interval_hits_host(pkg_rank, vuln_lo, vuln_hi, sec_lo, sec_hi,
+                       flags):
+    """NumPy reference (differential testing)."""
+    import numpy as np
+    r = pkg_rank[:, None]
+    vuln_any = ((vuln_lo <= r) & (r <= vuln_hi)).any(axis=1)
+    sec_any = ((sec_lo <= r) & (r <= sec_hi)).any(axis=1)
+    has_vuln = (flags & 1).astype(bool)
+    force = (flags & 2).astype(bool)
+    has_sec = (flags & 4).astype(bool)
+    not_sec = np.where(has_sec, ~sec_any, True)
+    without_vuln = np.where(has_sec, ~sec_any, False)
+    return force | np.where(has_vuln, vuln_any & not_sec,
+                            without_vuln)

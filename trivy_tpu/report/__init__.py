@@ -1,0 +1,10 @@
+"""Report writers (reference: pkg/report/writer.go:58-98).
+
+Formats: json (golden-comparable), table. Further formats (sarif,
+cyclonedx, spdx, github, template, cosign-vuln) register here as they
+land.
+"""
+
+from .writer import write_report
+
+__all__ = ["write_report"]

@@ -1,0 +1,102 @@
+"""json + table writers."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+from ..types import Report, Severity
+
+_SEV_ORDER = ["CRITICAL", "HIGH", "MEDIUM", "LOW", "UNKNOWN"]
+
+
+def write_report(report: Report, fmt: str = "table",
+                 output=None, severities: Optional[list] = None)\
+        -> None:
+    out = output or sys.stdout
+    if fmt == "json":
+        json.dump(report.to_dict(), out, indent=2)
+        out.write("\n")
+    elif fmt == "table":
+        out.write(render_table(report, severities))
+    else:
+        raise ValueError(f"unknown format: {fmt}")
+
+
+def render_table(report: Report,
+                 severities: Optional[list] = None) -> str:
+    sevs = [str(s) if isinstance(s, Severity) else s
+            for s in (severities or _SEV_ORDER)]
+    lines = []
+    for result in report.results:
+        header = result.target
+        if result.vulnerabilities:
+            counts = {s: 0 for s in _SEV_ORDER}
+            for v in result.vulnerabilities:
+                counts[v.severity if v.severity in counts
+                       else "UNKNOWN"] += 1
+            total = sum(counts.values())
+            summary = ", ".join(
+                f"{s}: {counts[s]}" for s in sevs if s in counts)
+            lines.append("")
+            lines.append(header)
+            lines.append("=" * len(header))
+            lines.append(f"Total: {total} ({summary})")
+            lines.append("")
+            rows = [("Library", "Vulnerability", "Severity",
+                     "Installed Version", "Fixed Version", "Title")]
+            for v in sorted(result.vulnerabilities,
+                            key=lambda v: (_sev_rank(v.severity),
+                                           v.pkg_name)):
+                title = v.vulnerability.title or ""
+                if len(title) > 48:
+                    title = title[:45] + "..."
+                rows.append((v.pkg_name, v.vulnerability_id,
+                             v.severity, v.installed_version,
+                             v.fixed_version, title))
+            lines.extend(_table(rows))
+        if result.secrets:
+            lines.append("")
+            lines.append(header + " (secrets)")
+            lines.append("=" * (len(header) + 10))
+            rows = [("Category", "Severity", "Title", "Lines")]
+            for s in result.secrets:
+                rows.append((s.category, s.severity, s.title,
+                             f"{s.start_line}-{s.end_line}"))
+            lines.extend(_table(rows))
+        if result.misconfigurations:
+            lines.append("")
+            lines.append(header + " (misconfigurations)")
+            lines.append("=" * (len(header) + 20))
+            rows = [("ID", "Severity", "Status", "Title")]
+            for m in result.misconfigurations:
+                rows.append((getattr(m, "id", ""),
+                             getattr(m, "severity", ""),
+                             getattr(m, "status", ""),
+                             getattr(m, "title", "")))
+            lines.extend(_table(rows))
+    if not lines:
+        return "\n"
+    return "\n".join(lines) + "\n"
+
+
+def _sev_rank(s: str) -> int:
+    try:
+        return _SEV_ORDER.index(s)
+    except ValueError:
+        return len(_SEV_ORDER)
+
+
+def _table(rows: list) -> list:
+    widths = [max(len(str(r[i])) for r in rows)
+              for i in range(len(rows[0]))]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out = [sep]
+    for i, row in enumerate(rows):
+        out.append("| " + " | ".join(
+            str(c).ljust(w) for c, w in zip(row, widths)) + " |")
+        if i == 0:
+            out.append(sep)
+    out.append(sep)
+    return out

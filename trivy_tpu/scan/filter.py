@@ -1,0 +1,69 @@
+"""Result filtering (reference: pkg/result/filter.go:31-).
+
+Severity filter, --ignore-unfixed, .trivyignore id list, and the
+uniqueness pass (filter.go shouldOverwrite: for duplicate
+(ID, pkg, path, version) keep the entry that has a fixed version).
+OPA Rego ignore policies are handled by the policy hook when provided.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ..types import Severity
+
+
+def load_ignore_file(path: str = ".trivyignore") -> list:
+    if not path or not os.path.exists(path):
+        return []
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line.split()[0])
+    return out
+
+
+def filter_results(results: list, severities: list,
+                   ignore_unfixed: bool = False,
+                   ignored_ids: Optional[list] = None,
+                   policy: Optional[Callable] = None) -> list:
+    sev_names = {str(s) if isinstance(s, Severity) else s
+                 for s in severities}
+    ignored = set(ignored_ids or [])
+
+    for r in results:
+        r.vulnerabilities = _filter_vulns(
+            r.vulnerabilities, sev_names, ignore_unfixed, ignored,
+            policy)
+        r.misconfigurations = [
+            m for m in r.misconfigurations
+            if getattr(m, "severity", "") in sev_names
+            and getattr(m, "id", "") not in ignored]
+        r.secrets = [s for s in r.secrets
+                     if s.severity in sev_names
+                     and s.rule_id not in ignored]
+    return results
+
+
+def _filter_vulns(vulns: list, sev_names: set, ignore_unfixed: bool,
+                  ignored: set, policy) -> list:
+    unique: dict = {}
+    for v in vulns:
+        if v.severity not in sev_names:
+            continue
+        if ignore_unfixed and not v.fixed_version:
+            continue
+        if v.vulnerability_id in ignored:
+            continue
+        if policy is not None and policy(v):
+            continue
+        key = (v.vulnerability_id, v.pkg_name, v.pkg_path,
+               v.installed_version)
+        old = unique.get(key)
+        # shouldOverwrite: prefer the entry carrying a fix
+        if old is None or (not old.fixed_version and v.fixed_version):
+            unique[key] = v
+    return list(unique.values())

@@ -1,0 +1,272 @@
+"""Local scan driver (reference: pkg/scanner/local/scan.go:78-175).
+
+ApplyLayers → OS + language vuln detection (the batched interval
+kernel) → secrets/misconf results → FillInfo enrichment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..applier import apply_layers
+from ..db import AdvisoryStore
+from ..detect.batch import PairJob, detect_pairs
+from ..detect.enrich import fill_info
+from ..detect.library import _TYPES as LIB_TYPES
+from ..detect.library import _fixed_versions, normalize_pkg_name
+from ..detect.ospkg.drivers import DRIVERS, format_src_version
+from ..types import (OS, DetectedVulnerability, Result, ResultClass,
+                     Vulnerability)
+from ..types.common import SEVERITIES
+from ..utils import get_logger
+
+log = get_logger("scan.local")
+
+# pre-defined targets for aggregated package types (scan.go pkgTargets)
+_PKG_TARGETS = {
+    "python-pkg": "Python",
+    "node-pkg": "Node.js",
+    "gemspec": "Ruby",
+    "jar": "Java",
+}
+
+
+@dataclass
+class ScanTarget:
+    name: str
+    artifact_id: str
+    blob_ids: list
+
+
+class LocalScanner:
+    def __init__(self, cache, store: Optional[AdvisoryStore] = None):
+        self.cache = cache
+        self.store = store or AdvisoryStore()
+
+    def scan(self, target: ScanTarget, options: ScanOptions) -> tuple:
+        """Returns (results, os)."""
+        blobs = [self.cache.get_blob(b) for b in target.blob_ids]
+        detail = apply_layers(blobs)
+
+        if detail.os is None and detail.packages:
+            detail.os = OS(family="none")
+        if detail.os is None and detail.repository is not None:
+            detail.os = OS(family=detail.repository.family,
+                           name=detail.repository.release)
+
+        results: list = []
+        pkg_results: list = []
+        if options.list_all_packages:
+            r = self._os_pkgs_result(target.name, detail)
+            if r is not None:
+                pkg_results.append(r)
+            pkg_results.extend(self._lang_pkgs_results(detail))
+
+        if "vuln" in options.security_checks:
+            vuln_results, eosl = self._scan_vulns(target.name, detail,
+                                                  options)
+            if detail.os is not None:
+                detail.os.eosl = eosl
+            results.extend(self._fill_pkgs(pkg_results, vuln_results))
+        else:
+            results.extend(pkg_results)
+
+        if "config" in options.security_checks:
+            results.extend(self._misconf_results(detail))
+
+        if "secret" in options.security_checks:
+            results.extend(self._secret_results(detail))
+
+        for r in results:
+            fill_info(self.store, r.vulnerabilities)
+        return results, detail.os
+
+    # --- vulnerabilities ---
+
+    def _scan_vulns(self, target: str, detail, options) -> tuple:
+        jobs: list = []
+        eosl = False
+
+        if "os" in options.vuln_type and detail.os is not None \
+                and detail.packages:
+            driver = DRIVERS.get(detail.os.family)
+            if driver is not None:
+                eosl = not driver.is_supported(detail.os.name)
+                bucket = driver.bucket(detail.os.name,
+                                       detail.repository)
+                for pkg in detail.packages:
+                    installed = driver.installed(pkg)
+                    for adv in self.store.get(bucket,
+                                              driver.src_name(pkg)):
+                        jobs.append(self._ospkg_job(
+                            driver, pkg, installed, adv))
+            elif detail.os.family not in ("none", ""):
+                log.warning("unsupported os: %s", detail.os.family)
+
+        if "library" in options.vuln_type:
+            for app in detail.applications:
+                if app.type not in LIB_TYPES:
+                    continue
+                eco, grammar = LIB_TYPES[app.type]
+                for lib in app.libraries:
+                    name = normalize_pkg_name(eco, lib.name)
+                    for adv in self.store.get_advisories(
+                            f"{eco}::", name):
+                        jobs.append(self._lib_job(
+                            app, grammar, lib, adv))
+
+        detected = detect_pairs(jobs, backend=options.backend)
+
+        os_vulns: list = []
+        app_vulns: dict = {}
+        for payload in detected:
+            kind, key, vuln = payload
+            if kind == "os":
+                os_vulns.append(vuln)
+            else:
+                app_vulns.setdefault(key, []).append(vuln)
+
+        results = []
+        if os_vulns or (detail.os is not None and detail.packages):
+            target_name = target
+            if detail.os is not None and detail.os.family and \
+                    detail.os.family != "none":
+                target_name = (f"{target} ({detail.os.family} "
+                               f"{detail.os.name})")
+            results.append(Result(
+                target=target_name,
+                class_=ResultClass.OSPKG,
+                type=detail.os.family if detail.os else "",
+                vulnerabilities=sorted(
+                    os_vulns, key=lambda v: (v.pkg_name,
+                                             v.vulnerability_id)),
+            ))
+        for app in detail.applications:
+            key = (app.type, app.file_path)
+            vulns = app_vulns.get(key)
+            if not vulns:
+                continue
+            target_name = app.file_path or \
+                _PKG_TARGETS.get(app.type, "")
+            results.append(Result(
+                target=target_name,
+                class_=ResultClass.LANGPKG,
+                type=app.type,
+                vulnerabilities=sorted(
+                    vulns, key=lambda v: (v.pkg_name,
+                                          v.vulnerability_id)),
+            ))
+        return results, eosl
+
+    def _ospkg_job(self, driver, pkg, installed, adv) -> PairJob:
+        v = DetectedVulnerability(
+            vulnerability_id=adv.vulnerability_id,
+            vendor_ids=adv.vendor_ids,
+            pkg_id=pkg.id,
+            pkg_name=pkg.name,
+            installed_version=installed,
+            fixed_version=adv.fixed_version,
+            layer=pkg.layer,
+            ref=pkg.ref,
+            data_source=adv.data_source,
+        )
+        if driver.severity_source and adv.severity:
+            v.severity_source = driver.severity_source
+            v.vulnerability = Vulnerability(
+                severity=str(SEVERITIES[adv.severity])
+                if 0 <= adv.severity < 5 else "UNKNOWN")
+        return PairJob(
+            grammar=driver.grammar,
+            pkg_version=installed,
+            fixed_version=adv.fixed_version,
+            affected_version=adv.affected_version,
+            report_unfixed=driver.report_unfixed,
+            kind="ospkg",
+            payload=("os", None, v),
+        )
+
+    def _lib_job(self, app, grammar, lib, adv) -> PairJob:
+        v = DetectedVulnerability(
+            vulnerability_id=adv.vulnerability_id,
+            pkg_id=lib.id,
+            pkg_name=lib.name,
+            pkg_path=lib.file_path,
+            installed_version=lib.version,
+            fixed_version=_fixed_versions(adv),
+            layer=lib.layer,
+            data_source=adv.data_source,
+        )
+        return PairJob(
+            grammar=grammar,
+            pkg_version=lib.version,
+            vulnerable=adv.vulnerable_versions,
+            patched=adv.patched_versions,
+            unaffected=adv.unaffected_versions,
+            payload=("lib", (app.type, app.file_path), v),
+        )
+
+    # --- other result classes ---
+
+    def _os_pkgs_result(self, target, detail) -> Optional[Result]:
+        if not detail.packages or detail.os is None:
+            return None
+        pkgs = sorted(detail.packages, key=lambda p: p.name)
+        return Result(
+            target=f"{target} ({detail.os.family} {detail.os.name})",
+            class_=ResultClass.OSPKG,
+            type=detail.os.family,
+            packages=pkgs,
+        )
+
+    def _lang_pkgs_results(self, detail) -> list:
+        out = []
+        for app in detail.applications:
+            if not app.libraries:
+                continue
+            target = app.file_path or _PKG_TARGETS.get(app.type, "")
+            out.append(Result(target=target,
+                              class_=ResultClass.LANGPKG,
+                              type=app.type,
+                              packages=app.libraries))
+        return out
+
+    def _fill_pkgs(self, pkg_results, vuln_results) -> list:
+        """Merge package listings into matching vuln results
+        (scan.go fillPkgsInVulns)."""
+        if not pkg_results:
+            return vuln_results
+        out = []
+        used = set()
+        for vr in vuln_results:
+            for i, pr in enumerate(pkg_results):
+                if (pr.class_, pr.target) == (vr.class_, vr.target):
+                    vr.packages = pr.packages
+                    used.add(i)
+                    break
+            out.append(vr)
+        for i, pr in enumerate(pkg_results):
+            if i not in used:
+                out.append(pr)
+        return out
+
+    def _secret_results(self, detail) -> list:
+        out = []
+        for secret in detail.secrets:
+            out.append(Result(
+                target=secret.file_path,
+                class_=ResultClass.SECRET,
+                secrets=secret.findings,
+            ))
+        return out
+
+    def _misconf_results(self, detail) -> list:
+        out = []
+        for mc in detail.misconfigurations:
+            out.append(Result(
+                target=mc.file_path,
+                class_=ResultClass.CONFIG,
+                type=mc.file_type,
+                misconfigurations=[mc],
+            ))
+        return out
