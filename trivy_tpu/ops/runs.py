@@ -1,0 +1,94 @@
+"""Class-run detection on TPU — the run-gate sieve stage.
+
+For each spec (byte class, run length R): does the segment contain R
+consecutive bytes all in the class? Pure elementwise membership
+compares + log-doubling erosion (AND of left-shifted masks) — the same
+no-gather discipline as the literal sieve.
+
+Segments must overlap by ≥ max run length so straddling runs appear
+whole in one segment (trivy_tpu.secret.batch sizes the overlap from
+the plan).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RunSpec:
+    ranges: tuple        # ((lo, hi), ...) inclusive byte ranges
+    runlen: int
+
+    @classmethod
+    def from_byteset(cls, bs: frozenset, runlen: int) -> "RunSpec":
+        ranges = []
+        for b in sorted(bs):
+            if ranges and b == ranges[-1][1] + 1:
+                ranges[-1][1] = b
+            else:
+                ranges.append([b, b])
+        return cls(ranges=tuple((lo, hi) for lo, hi in ranges),
+                   runlen=runlen)
+
+
+def _membership(x: jax.Array, spec: RunSpec) -> jax.Array:
+    m = jnp.zeros(x.shape, bool)
+    for lo, hi in spec.ranges:
+        if lo == hi:
+            m = m | (x == lo)
+        else:
+            m = m | ((x >= lo) & (x <= hi))
+    return m
+
+
+def _erode(m: jax.Array, R: int) -> jax.Array:
+    """e[i] = AND of m[i..i+R-1] (log-doubling shifts)."""
+    e = m
+    span = 1
+    while span < R:
+        step = min(span, R - span)
+        shifted = jnp.pad(e[:, step:], ((0, 0), (0, step)))
+        e = e & shifted
+        span += step
+    return e
+
+
+def make_run_hits(specs: tuple):
+    """Compile a jitted [B, L] → [B, n_specs] bool run detector."""
+
+    @jax.jit
+    def run_hits(segments: jax.Array) -> jax.Array:
+        x = segments.astype(jnp.int32)
+        cols = []
+        for spec in specs:
+            m = _membership(x, spec)
+            cols.append(_erode(m, spec.runlen).any(axis=1))
+        return jnp.stack(cols, axis=1)
+
+    return run_hits
+
+
+def run_hits_host(segments: np.ndarray, specs: tuple) -> np.ndarray:
+    """NumPy reference."""
+    B, L = segments.shape
+    out = np.zeros((B, len(specs)), bool)
+    x = segments.astype(np.int32)
+    for si, spec in enumerate(specs):
+        m = np.zeros_like(x, bool)
+        for lo, hi in spec.ranges:
+            m |= (x >= lo) & (x <= hi)
+        e = m
+        span = 1
+        while span < spec.runlen:
+            step = min(span, spec.runlen - span)
+            shifted = np.pad(e[:, step:], ((0, 0), (0, step)))
+            e = e & shifted
+            span += step
+        out[:, si] = e.any(axis=1)
+    return out

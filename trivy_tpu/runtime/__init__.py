@@ -1,0 +1,13 @@
+"""Batch scan runtime — the north-star path (BASELINE.json: scan N
+cached container images, secrets + vulns, sharded across a TPU mesh).
+
+The reference scans images one at a time, with goroutine parallelism
+inside each scan (k8s fleet scans are a sequential loop per artifact —
+SURVEY.md §2.6). Here the batch IS the unit: every image's secret
+candidates share one sieve dispatch, every image's (package, advisory)
+pairs share one interval dispatch, and a mesh shards both over chips.
+"""
+
+from .batch import BatchScanRunner, BatchScanResult
+
+__all__ = ["BatchScanRunner", "BatchScanResult"]

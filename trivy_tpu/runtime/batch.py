@@ -1,0 +1,177 @@
+"""Multi-image batch scanner.
+
+Pipeline per batch of images:
+
+  1. host: load each image, compute cache keys, walk MISSING layers
+     through the non-secret analyzers; secret candidates accumulate
+     across all images tagged (image, layer);
+  2. TPU dispatch #1: one literal-sieve pass over every candidate
+     byte of every image (trivy_tpu.secret.batch);
+  3. host: PutBlob per layer, ApplyLayers per image, advisory name
+     join per package across all images;
+  4. TPU dispatch #2: one interval-membership pass over every
+     (package, advisory) pair of every image (trivy_tpu.detect.batch);
+  5. host: per-image result assembly, enrichment.
+
+Cached images skip 1-2 entirely (content-addressed MissingBlobs —
+the reference's resume mechanism, SURVEY.md §5). Two kernel dispatches
+per BATCH — not per image — amortize dispatch latency across the
+whole fleet (the reference's k8s scanner loops artifacts sequentially,
+SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..artifact.artifact import ArtifactOption, ImageArtifact
+from ..artifact.cache import MemoryCache
+from ..artifact.image import load_image
+from ..db import AdvisoryStore
+from ..detect.batch import detect_pairs
+from ..scan.local import LocalScanner, ScanTarget
+from ..types import Metadata, Report, ScanOptions
+from ..utils import get_logger
+
+log = get_logger("runtime.batch")
+
+
+@dataclass
+class BatchScanResult:
+    name: str
+    report: Optional[Report] = None
+    error: str = ""
+
+
+class BatchScanRunner:
+    def __init__(self, store: Optional[AdvisoryStore] = None,
+                 cache=None, backend: str = "tpu", mesh=None,
+                 secret_scanner=None):
+        self.store = store or AdvisoryStore()
+        self.cache = cache if cache is not None else MemoryCache()
+        self.backend = backend
+        self.mesh = mesh
+        if secret_scanner is None:
+            from ..secret.batch import BatchSecretScanner
+            secret_scanner = BatchSecretScanner(
+                backend="cpu-ref" if backend == "cpu-ref" else "tpu",
+                mesh=mesh)
+        self.secret_scanner = secret_scanner
+
+    def scan_paths(self, paths: list,
+                   options: Optional[ScanOptions] = None) -> list:
+        images, failures = [], {}
+        for i, p in enumerate(paths):
+            try:
+                images.append((i, load_image(p)))
+            except (OSError, ValueError) as e:
+                failures[i] = BatchScanResult(name=p, error=str(e))
+        results = self.scan_images([img for _, img in images],
+                                   options)
+        out = dict(failures)
+        for (i, _), res in zip(images, results):
+            out[i] = res
+        return [out[i] for i in range(len(paths))]
+
+    def scan_images(self, images: list,
+                    options: Optional[ScanOptions] = None) -> list:
+        options = options or ScanOptions(backend=self.backend)
+        scan_secrets = "secret" in options.security_checks
+
+        # ---- phase 1: analyze missing layers, collect candidates ----
+        artifacts = []
+        opt = ArtifactOption(scan_secrets=scan_secrets)
+        for img in images:
+            a = _CollectingImageArtifact(img, self.cache, opt)
+            a.reference = a.inspect()
+            artifacts.append(a)
+
+        # ---- phase 2: ONE sieve dispatch over all images ----
+        collected = [c for a in artifacts for c in a.collected]
+        if scan_secrets and collected:
+            found = self.secret_scanner.scan_files(
+                [(p, c) for _, p, c in collected])
+            _patch_blobs(self.cache, artifacts, collected, found)
+
+        # ---- phase 3: squash + advisory join (host) ----
+        scanner = LocalScanner(self.cache, self.store)
+        prepared = []
+        for a in artifacts:
+            ref = a.reference
+            prepared.append(scanner.prepare(
+                ScanTarget(name=ref.name, artifact_id=ref.id,
+                           blob_ids=ref.blob_ids), options))
+
+        # ---- phase 4: ONE interval dispatch over all images ----
+        all_jobs = []
+        for idx, p in enumerate(prepared):
+            for job in p.jobs:
+                job.payload = (idx, job.payload)
+                all_jobs.append(job)
+        detected_by_image: dict = {}
+        for idx, payload in detect_pairs(all_jobs,
+                                         backend=options.backend):
+            detected_by_image.setdefault(idx, []).append(payload)
+
+        # ---- phase 5: assemble per image ----
+        out = []
+        for idx, (a, p) in enumerate(zip(artifacts, prepared)):
+            results, os_found = scanner.finish(
+                p, detected_by_image.get(idx, []))
+            ref = a.reference
+            out.append(BatchScanResult(
+                name=ref.name,
+                report=Report(
+                    artifact_name=ref.name,
+                    artifact_type="container_image",
+                    metadata=Metadata(
+                        os=os_found,
+                        image_id=ref.image_metadata.id,
+                        diff_ids=ref.image_metadata.diff_ids,
+                        repo_tags=ref.image_metadata.repo_tags,
+                        image_config=ref.image_metadata.image_config,
+                    ),
+                    results=results,
+                )))
+        return out
+
+
+class _CollectingImageArtifact(ImageArtifact):
+    """ImageArtifact that defers secret scanning to the batch: its
+    _batch_secrets records (layer, path, content) and returns nothing;
+    the runner patches blobs once the global dispatch resolves."""
+
+    def inspect(self):
+        self.collected = []        # per-instance, even when cached
+        return super().inspect()
+
+    def _batch_secrets(self, candidates: list) -> dict:
+        self.collected = [(li, "/" + path, content)
+                          for li, path, content in candidates]
+        return {}
+
+
+def _patch_blobs(cache, artifacts, collected, found) -> None:
+    """Map batch results back to (artifact, layer) by entry order and
+    rewrite the affected cached blobs."""
+    owners = []
+    for a in artifacts:
+        for li, path, _ in a.collected:
+            owners.append((a, li, path))
+    by_blob: dict = {}
+    ci = 0
+    for s in found:
+        while ci < len(owners) and owners[ci][2] != s.file_path:
+            ci += 1
+        if ci == len(owners):
+            break
+        a, li, _ = owners[ci]
+        by_blob.setdefault((a, li), []).append(s)
+        ci += 1
+    for (a, li), secrets in by_blob.items():
+        blob_id = a.reference.blob_ids[li]
+        blob = cache.get_blob(blob_id)
+        if blob is not None:
+            blob.secrets = secrets
+            cache.put_blob(blob_id, blob)

@@ -39,13 +39,37 @@ class ScanTarget:
     blob_ids: list
 
 
+@dataclass
+class PreparedScan:
+    """Phase-1 output: everything needed to finish once the batched
+    interval kernel returns."""
+
+    target: ScanTarget
+    options: object
+    detail: object
+    jobs: list
+    eosl: bool
+    pkg_results: list
+
+
 class LocalScanner:
     def __init__(self, cache, store: Optional[AdvisoryStore] = None):
         self.cache = cache
         self.store = store or AdvisoryStore()
 
     def scan(self, target: ScanTarget, options: ScanOptions) -> tuple:
-        """Returns (results, os)."""
+        """Returns (results, os) — single-target convenience around
+        prepare + one kernel dispatch + finish."""
+        prepared = self.prepare(target, options)
+        detected = detect_pairs(prepared.jobs,
+                                backend=options.backend)
+        return self.finish(prepared, detected)
+
+    def prepare(self, target: ScanTarget,
+                options: ScanOptions) -> PreparedScan:
+        """ApplyLayers + advisory name-join → pair jobs. No kernel
+        work happens here, so a batch runner can merge many targets'
+        jobs into one dispatch."""
         blobs = [self.cache.get_blob(b) for b in target.blob_ids]
         detail = apply_layers(blobs)
 
@@ -55,7 +79,6 @@ class LocalScanner:
             detail.os = OS(family=detail.repository.family,
                            name=detail.repository.release)
 
-        results: list = []
         pkg_results: list = []
         if options.list_all_packages:
             r = self._os_pkgs_result(target.name, detail)
@@ -63,14 +86,29 @@ class LocalScanner:
                 pkg_results.append(r)
             pkg_results.extend(self._lang_pkgs_results(detail))
 
+        jobs, eosl = ([], False)
         if "vuln" in options.security_checks:
-            vuln_results, eosl = self._scan_vulns(target.name, detail,
-                                                  options)
+            jobs, eosl = self._vuln_jobs(detail, options)
+        return PreparedScan(target=target, options=options,
+                            detail=detail, jobs=jobs, eosl=eosl,
+                            pkg_results=pkg_results)
+
+    def finish(self, prepared: PreparedScan,
+               detected: list) -> tuple:
+        """Assemble results from the detected pair payloads."""
+        options = prepared.options
+        detail = prepared.detail
+        results: list = []
+
+        if "vuln" in options.security_checks:
             if detail.os is not None:
-                detail.os.eosl = eosl
-            results.extend(self._fill_pkgs(pkg_results, vuln_results))
+                detail.os.eosl = prepared.eosl
+            vuln_results = self._vuln_results(
+                prepared.target.name, detail, detected)
+            results.extend(self._fill_pkgs(prepared.pkg_results,
+                                           vuln_results))
         else:
-            results.extend(pkg_results)
+            results.extend(prepared.pkg_results)
 
         if "config" in options.security_checks:
             results.extend(self._misconf_results(detail))
@@ -84,7 +122,7 @@ class LocalScanner:
 
     # --- vulnerabilities ---
 
-    def _scan_vulns(self, target: str, detail, options) -> tuple:
+    def _vuln_jobs(self, detail, options) -> tuple:
         jobs: list = []
         eosl = False
 
@@ -115,9 +153,10 @@ class LocalScanner:
                             f"{eco}::", name):
                         jobs.append(self._lib_job(
                             app, grammar, lib, adv))
+        return jobs, eosl
 
-        detected = detect_pairs(jobs, backend=options.backend)
-
+    def _vuln_results(self, target: str, detail,
+                      detected: list) -> list:
         os_vulns: list = []
         app_vulns: dict = {}
         for payload in detected:
@@ -157,7 +196,7 @@ class LocalScanner:
                     vulns, key=lambda v: (v.pkg_name,
                                           v.vulnerability_id)),
             ))
-        return results, eosl
+        return results
 
     def _ospkg_job(self, driver, pkg, installed, adv) -> PairJob:
         v = DetectedVulnerability(

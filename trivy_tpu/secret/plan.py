@@ -21,7 +21,9 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..ops.keywords import CodeTable, build_code_table
-from .rx.anchor import analyze_rule
+from ..ops.runs import RunSpec
+from .rx.anchor import analyze_rule, run_gates, strip_elastic
+from .rx.parser import parse
 
 
 @dataclass
@@ -31,12 +33,18 @@ class RulePlan:
     anchored: bool = False
     anchors: list = field(default_factory=list)   # code indices
     window: int = 0               # bytes each side of an anchor hit
+    run_gate: list = field(default_factory=list)  # run-spec indices
 
 
 @dataclass
 class ScanPlan:
     table: CodeTable
     rules: list                   # list[RulePlan], same order as input
+    run_specs: list = field(default_factory=list)  # [RunSpec]
+
+    @property
+    def max_runlen(self) -> int:
+        return max((s.runlen for s in self.run_specs), default=0)
 
 
 def build_scan_plan(rules) -> ScanPlan:
@@ -55,6 +63,8 @@ def build_scan_plan(rules) -> ScanPlan:
             literals.extend(ra.literals)
 
     table = build_code_table(literals)
+    run_specs: list = []
+    spec_index: dict = {}
     plans = []
     for i, (kws, ra) in enumerate(analyses):
         rp = RulePlan(rule_index=i,
@@ -63,5 +73,21 @@ def build_scan_plan(rules) -> ScanPlan:
             rp.anchored = True
             rp.anchors = sorted({table.index(a) for a in ra.literals})
             rp.window = ra.window
+        else:
+            # non-anchored: a mandatory long class-run is a sound
+            # extra gate before the whole-file host scan
+            rule = rules[i]
+            if rule.regex is not None:
+                try:
+                    core, _ = strip_elastic(parse(rule.regex.pattern))
+                    gates = run_gates(core)
+                except Exception:
+                    gates = []
+                for bs, runlen in gates:
+                    spec = RunSpec.from_byteset(bs, runlen)
+                    if spec not in spec_index:
+                        spec_index[spec] = len(run_specs)
+                        run_specs.append(spec)
+                    rp.run_gate.append(spec_index[spec])
         plans.append(rp)
-    return ScanPlan(table=table, rules=plans)
+    return ScanPlan(table=table, rules=plans, run_specs=run_specs)

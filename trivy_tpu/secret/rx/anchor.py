@@ -315,6 +315,44 @@ def _best_candidate(node) -> Optional[_Cand]:
     raise TypeError(node)
 
 
+MIN_RUN_GATE = 16         # shortest class-run worth a TPU gate
+MAX_RUN_GATE = 64         # cap (also bounds required segment overlap)
+
+
+def run_gates(node) -> list:
+    """Mandatory long class-runs: every match must contain ``runlen``
+    consecutive bytes all drawn from ``byteset``. A sound NECESSARY
+    condition used to gate whole-file host scans of rules the window
+    proof rejects (e.g. aws-secret-access-key's 40-char base64 body).
+
+    Returns [(byteset, runlen)] — possibly several; all must hold.
+    Only spine-mandatory repeats count (an optional or alternated run
+    proves nothing)."""
+    out = []
+    if isinstance(node, Rep):
+        if node.min >= 1:
+            if isinstance(node.node, Lit) and \
+                    node.min >= MIN_RUN_GATE:
+                out.append((node.node.bytes,
+                            min(node.min, MAX_RUN_GATE)))
+            else:
+                out.extend(run_gates(node.node))
+    elif isinstance(node, Cat):
+        for p in node.parts:
+            out.extend(run_gates(p))
+    elif isinstance(node, Alt):
+        # a run mandatory in EVERY branch is mandatory; keep the
+        # common (byteset, len≥) pairs conservatively: only when all
+        # branches yield an identical gate
+        branch_gates = [run_gates(o) for o in node.options]
+        if branch_gates and all(branch_gates):
+            first = set(branch_gates[0])
+            for bg in branch_gates[1:]:
+                first &= set(bg)
+            out.extend(sorted(first, key=lambda g: -g[1]))
+    return out
+
+
 @dataclass
 class RuleAnchor:
     """Verification plan for one rule."""
