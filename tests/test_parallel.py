@@ -83,7 +83,7 @@ def test_batch_scanner_over_mesh(pack):
     ]
     plain = BatchSecretScanner(backend="tpu")
     meshy = BatchSecretScanner(backend="tpu", mesh=make_mesh(8))
-    r1 = plain.scan_files(files)
-    r2 = meshy.scan_files(files)
+    r1 = [s for _, s in plain.scan_files(files)]
+    r2 = [s for _, s in meshy.scan_files(files)]
     assert [s.to_dict() for s in r1] == [s.to_dict() for s in r2]
     assert {s.file_path for s in r1} == {"a/config.py", "c/token.env"}

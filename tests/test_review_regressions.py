@@ -62,15 +62,95 @@ def test_batch_secrets_layer_attribution(tmp_path):
         "--backend", "cpu-ref", "--no-cache"])
     assert code == 0
     report = json.loads(out.read_text())
-    # reference semantics: layer 2's clean version wins for the path
-    # (mergeSecrets overwrites per rule), and the layer-1 finding is
-    # preserved with layer-1 attribution via mergeSecrets' keep logic
     secrets = [r for r in report.get("Results") or []
                if r["Class"] == "secret"]
-    if secrets:
-        finding = secrets[0]["Secrets"][0]
-        # attribution must be the layer that contained the secret
-        assert finding["Layer"]["DiffID"] != ""
+    assert secrets, "layer-1 finding must be preserved"
+    finding = secrets[0]["Secrets"][0]
+    # attribution must be the exact layer that contained the secret
+    diff_ids = report["Metadata"]["DiffIDs"]
+    assert finding["Layer"]["DiffID"] == diff_ids[0]
+
+
+def test_batch_secrets_clean_layer_first(tmp_path):
+    """Same path in two layers, clean version FIRST: the cursor-based
+    mapping used to attach the finding to the clean lower layer."""
+    from tests.test_e2e_image import make_image_tar, run_cli
+    import json
+    tar = make_image_tar(tmp_path, [
+        {"app/.env": b"nothing to see\n"},
+        {"app/.env": b"GITHUB_TOKEN=ghp_" + b"B" * 36 + b"\n"},
+    ])
+    out = tmp_path / "r.json"
+    code, _ = run_cli([
+        "image", "--input", tar, "--format", "json",
+        "--output", str(out), "--security-checks", "secret",
+        "--backend", "cpu-ref", "--no-cache"])
+    assert code == 0
+    report = json.loads(out.read_text())
+    secrets = [r for r in report.get("Results") or []
+               if r["Class"] == "secret"]
+    assert secrets
+    finding = secrets[0]["Secrets"][0]
+    diff_ids = report["Metadata"]["DiffIDs"]
+    assert finding["Layer"]["DiffID"] == diff_ids[1]
+
+
+def test_batch_two_images_same_path_attribution(tmp_path):
+    """Two images sharing a path; secret only in the SECOND image.
+    The finding must land on image 2 and image 1 must come back clean
+    (VERDICT r1 weak #1: path-cursor misattribution across images)."""
+    from tests.test_e2e_image import make_image_tar
+    from trivy_tpu.runtime.batch import BatchScanRunner
+    from trivy_tpu.types import ScanOptions
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    tar1 = make_image_tar(tmp_path / "a", [
+        {"srv/cfg.conf": b"plain config, nothing secret\n"}])
+    tar2 = make_image_tar(tmp_path / "b", [
+        {"srv/cfg.conf": b"token=ghp_" + b"C" * 36 + b"\n"}])
+
+    runner = BatchScanRunner(backend="cpu-ref")
+    res = runner.scan_paths(
+        [tar1, tar2],
+        ScanOptions(backend="cpu-ref", security_checks=["secret"]))
+    assert res[0].error == "" and res[1].error == ""
+
+    def secret_count(r):
+        return sum(len(x.secrets) for x in r.report.results)
+
+    assert secret_count(res[0]) == 0, "clean image must stay clean"
+    assert secret_count(res[1]) == 1, "finding must follow its image"
+
+
+def test_batch_per_image_counts_match_solo_scans(tmp_path):
+    """Batch scanning a small fleet must reproduce per-image secret
+    counts of individual scans (same-path files planted everywhere)."""
+    from tests.test_e2e_image import make_image_tar
+    from trivy_tpu.runtime.batch import BatchScanRunner
+    from trivy_tpu.types import ScanOptions
+
+    layers = []
+    for i in range(4):
+        files = {"etc/app.conf": b"shared body %d\n" % i}
+        if i % 2 == 1:
+            files["etc/app.conf"] += (
+                b"aws=AKIAIOSFODNN7EXAMPL%d\n" % i)
+        layers.append([files])
+    for i in range(4):
+        (tmp_path / str(i)).mkdir()
+    tars = [make_image_tar(tmp_path / str(i), lys)
+            for i, lys in enumerate(layers)]
+
+    opts = ScanOptions(backend="cpu-ref", security_checks=["secret"])
+    batch = BatchScanRunner(backend="cpu-ref").scan_paths(tars, opts)
+    solo = [BatchScanRunner(backend="cpu-ref").scan_paths([t], opts)[0]
+            for t in tars]
+
+    def counts(r):
+        return sum(len(x.secrets) for x in r.report.results)
+
+    assert [counts(r) for r in batch] == [counts(r) for r in solo]
 
 
 def test_redhat_family_supported():

@@ -124,24 +124,16 @@ class ImageArtifact:
         """ONE kernel dispatch across every missing layer's files.
         Image paths get a leading '/' (secret.go:97-101). The same
         path can exist in several layers with different contents —
-        results map back by ENTRY ORDER (scan_files preserves it),
-        never by path alone."""
+        results map back by the entry INDEX scan_files returns,
+        never by path."""
         if not candidates or not self.opt.scan_secrets:
             return {}
         scanner = _secret_scanner(self.opt)
         files = [("/" + path, content)
                  for _, path, content in candidates]
-        found = scanner.scan_files(files)
         out: dict = {}
-        ci = 0
-        for s in found:
-            while ci < len(candidates) and \
-                    "/" + candidates[ci][1] != s.file_path:
-                ci += 1
-            if ci == len(candidates):
-                break
-            out.setdefault(candidates[ci][0], []).append(s)
-            ci += 1
+        for idx, s in scanner.scan_files(files):
+            out.setdefault(candidates[idx][0], []).append(s)
         return out
 
     def _skipped(self, path: str) -> bool:
@@ -183,8 +175,8 @@ class LocalFSArtifact:
 
         if result.secret_candidates and self.opt.scan_secrets:
             scanner = _secret_scanner(self.opt)
-            result.secrets = scanner.scan_files(
-                [(p, c) for p, c in result.secret_candidates])
+            result.secrets = [s for _, s in scanner.scan_files(
+                [(p, c) for p, c in result.secret_candidates])]
 
         blob = result.to_blob_info()
         raw = json.dumps(blob.to_dict(), sort_keys=True).encode()

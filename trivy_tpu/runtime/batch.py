@@ -92,7 +92,7 @@ class BatchScanRunner:
         if scan_secrets and collected:
             found = self.secret_scanner.scan_files(
                 [(p, c) for _, p, c in collected])
-            _patch_blobs(self.cache, artifacts, collected, found)
+            _patch_blobs(self.cache, artifacts, found)
 
         # ---- phase 3: squash + advisory join (host) ----
         scanner = LocalScanner(self.cache, self.store)
@@ -152,23 +152,19 @@ class _CollectingImageArtifact(ImageArtifact):
         return {}
 
 
-def _patch_blobs(cache, artifacts, collected, found) -> None:
-    """Map batch results back to (artifact, layer) by entry order and
-    rewrite the affected cached blobs."""
+def _patch_blobs(cache, artifacts, found) -> None:
+    """Map batch results back to (artifact, layer) by the entry index
+    scan_files returns and rewrite the affected cached blobs. Path
+    strings are never consulted: fleets share file trees, so identical
+    paths across images/layers are the common case, not the exception."""
     owners = []
     for a in artifacts:
-        for li, path, _ in a.collected:
-            owners.append((a, li, path))
+        for li, _path, _ in a.collected:
+            owners.append((a, li))
     by_blob: dict = {}
-    ci = 0
-    for s in found:
-        while ci < len(owners) and owners[ci][2] != s.file_path:
-            ci += 1
-        if ci == len(owners):
-            break
-        a, li, _ = owners[ci]
+    for idx, s in found:
+        a, li = owners[idx]
         by_blob.setdefault((a, li), []).append(s)
-        ci += 1
     for (a, li), secrets in by_blob.items():
         blob_id = a.reference.blob_ids[li]
         blob = cache.get_blob(blob_id)

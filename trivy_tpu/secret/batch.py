@@ -94,7 +94,11 @@ class BatchSecretScanner:
 
     def scan_files(self, files: Iterable) -> list:
         """``files``: iterable of (path, content-bytes).
-        Returns list of types.Secret (only files with findings)."""
+        Returns list of ``(entry_index, types.Secret)`` pairs, only for
+        entries with findings. Callers MUST map results back by the
+        returned index, never by path: the same path routinely appears
+        in several entries (every alpine image shares a file tree) and
+        path-based attribution misassigns findings across them."""
         entries = [
             _FileEntry(path=p, content=c, index=i)
             for i, (p, c) in enumerate(files)
@@ -111,7 +115,7 @@ class BatchSecretScanner:
                           self.scanner.exclude_block)
             secret = sub.scan(fe.path, fe.content)
             if secret.findings:
-                results.append(secret)
+                results.append((fe.index, secret))
         return results
 
     # --- sieve stages ---
