@@ -1,0 +1,125 @@
+"""Golden parity vs the reference's committed integration outputs.
+
+The reference's integration suite runs its CLI against fs fixtures +
+the YAML fixture advisory DB and diffs JSON against *.golden files
+(integration/fs_test.go, integration_test.go:27-59). The Go binary
+cannot be built here (no Go toolchain, zero egress), so these tests
+run OUR CLI on the SAME fixtures with the SAME fixture DB and diff
+against the SAME goldens — the strongest parity signal available.
+
+Normalization: empty ``"Layer": {}`` objects are dropped on both
+sides. Go's encoding/json cannot omit empty structs, and the goldens
+themselves are inconsistent about it (pip.json.golden carries
+"Layer": {} everywhere, conan.json.golden nowhere), so byte-equality
+on that artifact is not even well-defined in the reference tree.
+Everything else is compared strictly.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+REF = "/root/reference/integration"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted")
+
+
+def _db_paths():
+    return ",".join(sorted(glob.glob(
+        os.path.join(REF, "testdata/fixtures/db/*.yaml"))))
+
+
+def norm(o):
+    if isinstance(o, dict):
+        return {k: norm(v) for k, v in o.items()
+                if not (k == "Layer" and (v == {} or v is None))}
+    if isinstance(o, list):
+        return [norm(x) for x in o]
+    return o
+
+
+CASES = [
+    ("pip", ["--security-checks", "vuln", "--list-all-pkgs"],
+     "pip.json.golden"),
+    ("gomod", ["--security-checks", "vuln"], "gomod.json.golden"),
+    ("nodejs", ["--security-checks", "vuln", "--list-all-pkgs"],
+     "nodejs.json.golden"),
+    ("yarn", ["--security-checks", "vuln", "--list-all-pkgs"],
+     "yarn.json.golden"),
+    ("secrets", ["--security-checks", "vuln,secret",
+                 "--secret-config",
+                 "testdata/fixtures/fs/secrets/trivy-secret.yaml"],
+     "secrets.json.golden"),
+    ("pnpm", ["--security-checks", "vuln"], "pnpm.json.golden"),
+    ("pom", ["--security-checks", "vuln"], "pom.json.golden"),
+    ("gradle", ["--security-checks", "vuln"], "gradle.json.golden"),
+]
+
+
+@pytest.mark.parametrize("fixture,extra,golden",
+                         CASES, ids=[c[0] for c in CASES])
+def test_fs_golden(fixture, extra, golden, tmp_path, monkeypatch):
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "fs", f"testdata/fixtures/fs/{fixture}",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--db-fixtures", _db_paths(), *extra])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))
+    want = norm(json.load(open(
+        os.path.join(REF, "testdata", golden))))
+    assert ours == want
+
+
+def test_conan_packages_and_vuln(tmp_path, monkeypatch):
+    """conan.json.golden is stale in the reference tree (it lacks the
+    Metadata key and carries an unenriched vulnerability although
+    vulnerability.yaml HAS the CVE-2020-14155 detail record — the
+    committed pipeline would fill it, as every other golden shows).
+    Compare the reliable parts: the package list strictly, and the
+    vulnerability identity fields."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "fs", "testdata/fixtures/fs/conan",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache", "--list-all-pkgs",
+        "--db-fixtures", _db_paths(),
+        "--security-checks", "vuln"])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))["Results"][0]
+    want = norm(json.load(open(os.path.join(
+        REF, "testdata", "conan.json.golden"))))["Results"][0]
+    assert ours["Packages"] == want["Packages"]
+    ident = ["VulnerabilityID", "PkgID", "PkgName",
+             "InstalledVersion", "FixedVersion"]
+    assert [{k: v.get(k) for k in ident}
+            for v in ours["Vulnerabilities"]] == \
+           [{k: v.get(k) for k in ident}
+            for v in want["Vulnerabilities"]]
+
+
+def test_gomod_skip_files(tmp_path, monkeypatch):
+    """--skip-files parity (fs_test.go 'gomod with skip files')."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "fs", "testdata/fixtures/fs/gomod",
+        "--skip-files", "/testdata/fixtures/fs/gomod/submod2/go.mod",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--db-fixtures", _db_paths(),
+        "--security-checks", "vuln"])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))
+    want = norm(json.load(open(
+        os.path.join(REF, "testdata", "gomod-skip.json.golden"))))
+    assert ours == want

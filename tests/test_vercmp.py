@@ -172,7 +172,12 @@ class TestNpm:
         ("1.5.0", "*", True),
         ("2.5.0", "<1.0.0 || >=2.0.0", True),
         ("1.5.0", "<1.0.0 || >=2.0.0", False),
-        ("1.2.3-alpha.1", "<1.2.3", True),
+        # node-semver prerelease exclusion: a prerelease only
+        # satisfies a range whose comparators include a prerelease on
+        # the same major.minor.patch
+        ("1.2.3-alpha.1", "<1.2.3", False),
+        ("1.2.3-alpha.1", ">=1.2.3-alpha <1.3.0", True),
+        ("1.2.2-alpha", "<1.2.2", False),
         ("1.5.0", "1.2", False),             # 1.2 = [1.2.0, 1.3.0)
         ("1.2.9", "1.2", True),
     ])

@@ -68,10 +68,25 @@ class AnalysisResult:
         self.custom_resources.extend(other.custom_resources)
         self.secret_candidates.extend(other.secret_candidates)
 
+    def sort(self) -> None:
+        """Reference AnalysisResult.Sort (analyzer.go:175-230):
+        deterministic ordering before the blob is written."""
+        self.package_infos.sort(key=lambda p: p.file_path)
+        for pi in self.package_infos:
+            pi.packages.sort(key=lambda p: p.name)
+        self.applications.sort(key=lambda a: a.file_path)
+        for app in self.applications:
+            app.libraries.sort(key=lambda p: (p.name, p.version))
+        self.custom_resources.sort(key=lambda c: c.file_path)
+        self.secrets.sort(key=lambda s: s.file_path)
+        for sec in self.secrets:
+            sec.findings.sort(key=lambda f: (f.rule_id, f.start_line))
+        self.licenses.sort(
+            key=lambda lf: (lf.type, lf.file_path))
+
     def to_blob_info(self, diff_id: str = "", digest: str = "")\
             -> BlobInfo:
-        self.package_infos.sort(key=lambda p: p.file_path)
-        self.applications.sort(key=lambda a: a.file_path)
+        self.sort()
         return BlobInfo(
             diff_id=diff_id,
             digest=digest,

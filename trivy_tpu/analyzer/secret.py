@@ -32,6 +32,7 @@ def is_binary(content: bytes) -> bool:
 class SecretCandidateAnalyzer(Analyzer):
     type = "secret"
     version = 1
+    config_path = ""      # set from --secret-config (secret.go:135)
 
     def required(self, path, size=None):
         if size is not None and size < 10:
@@ -43,6 +44,10 @@ class SecretCandidateAnalyzer(Analyzer):
             return False
         ext = posixpath.splitext(name)[1].lower()
         if ext in SKIP_EXTS:
+            return False
+        # the secret-rule config itself is never scanned
+        if self.config_path and \
+                posixpath.basename(self.config_path) == path:
             return False
         return True
 

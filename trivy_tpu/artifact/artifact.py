@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..analyzer import AnalyzerGroup
 from ..analyzer.analyzer import AnalysisResult
+from ..handler import handler_versions, post_handle
 from ..types import (ArtifactInfo, ArtifactReference, BlobInfo,
                      ImageMetadata, Secret)
 from ..utils import get_logger
@@ -64,7 +65,9 @@ class ImageArtifact:
                     "skip_files": self.opt.skip_files,
                     "patterns": sorted(self.opt.file_patterns),
                     "secrets": self.opt.scan_secrets}
-        versions = self.group.versions()
+        versions = dict(self.group.versions())
+        versions.update({f"handler/{k}": v
+                         for k, v in handler_versions().items()})
         blob_ids = [calc_key(d, versions, options=opts_key)
                     for d in img.diff_ids]
         artifact_id = calc_key(img.id, versions, options=opts_key)
@@ -118,6 +121,7 @@ class ImageArtifact:
             blob = result.to_blob_info(diff_id=self.image.diff_ids[i])
             blob.opaque_dirs = opq_dirs
             blob.whiteout_files = wh_files
+            post_handle(blob)
             self.cache.put_blob(blob_ids[i], blob)
 
     def _batch_secrets(self, candidates: list) -> dict:
@@ -179,9 +183,12 @@ class LocalFSArtifact:
                 [(p, c) for p, c in result.secret_candidates])]
 
         blob = result.to_blob_info()
+        post_handle(blob)
+        # NOTE: blob.diff_id stays empty — filesystem scans report
+        # Layer: {} (reference: local artifacts have no layers); the
+        # content hash is only the cache key.
         raw = json.dumps(blob.to_dict(), sort_keys=True).encode()
         blob_id = "sha256:" + hashlib.sha256(raw).hexdigest()
-        blob.diff_id = blob_id
         self.cache.put_blob(blob_id, blob)
         return ArtifactReference(
             name=self.root, type="filesystem", id=blob_id,

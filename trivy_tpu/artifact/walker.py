@@ -61,23 +61,43 @@ def _skip_system(path: str) -> bool:
     return top in SKIP_SYSTEM_DIRS
 
 
+def _clean_skip(paths) -> set:
+    """walk.go:27-38: skip paths are cleaned and matched with the
+    leading '/' trimmed — against the path as WALKED (root-joined for
+    fs scans), not the root-relative analysis path."""
+    out = set()
+    for p in paths:
+        p = posixpath.normpath(p.replace(os.sep, "/")).lstrip("/")
+        out.add(p)
+    return out
+
+
 def walk_fs(root: str, skip_dirs: list = (),
             skip_files: list = ()) -> list:
     """Directory walk → [(rel_path, size, read_fn)] (reference:
-    walker/fs.go; shared skip logic walk.go:47-62)."""
+    walker/fs.go; shared skip logic walk.go:47-62). Skip lists match
+    both the cwd-relative walked path (reference behavior for
+    relative scan roots) and the root-relative path (convenience)."""
     out = []
-    skip_dirs = set(skip_dirs)
-    skip_files = set(skip_files)
+    skip_dirs = _clean_skip(skip_dirs)
+    skip_files = _clean_skip(skip_files)
+    root_prefix = posixpath.normpath(
+        root.replace(os.sep, "/")).lstrip("/")
+
+    def skipped(rel: str, skips: set) -> bool:
+        return rel in skips or \
+            posixpath.join(root_prefix, rel) in skips
+
     for dirpath, dirnames, filenames in os.walk(root):
         rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
         if rel_dir == ".":
             rel_dir = ""
         dirnames[:] = [
             d for d in dirnames
-            if posixpath.join(rel_dir, d) not in skip_dirs]
+            if not skipped(posixpath.join(rel_dir, d), skip_dirs)]
         for name in sorted(filenames):
             rel = posixpath.join(rel_dir, name)
-            if rel in skip_files:
+            if skipped(rel, skip_files):
                 continue
             full = os.path.join(dirpath, name)
             if not os.path.isfile(full) or os.path.islink(full):

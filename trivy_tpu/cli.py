@@ -120,6 +120,11 @@ def _artifact_option(args) -> ArtifactOption:
         cpu = new_scanner(load_config(args.secret_config))
         backend = "cpu-ref" if args.backend == "cpu-ref" else "tpu"
         scanner = BatchSecretScanner(scanner=cpu, backend=backend)
+        # the rule config itself is excluded from scanning
+        from .analyzer import registered_analyzers
+        for a in registered_analyzers():
+            if a.type == "secret":
+                a.config_path = args.secret_config
     return ArtifactOption(
         skip_dirs=[d for d in args.skip_dirs.split(",") if d],
         skip_files=[f for f in args.skip_files.split(",") if f],

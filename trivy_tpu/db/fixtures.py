@@ -8,6 +8,8 @@ remain usable."""
 
 from __future__ import annotations
 
+import re as _re
+
 from .store import AdvisoryStore
 
 try:
@@ -24,7 +26,16 @@ def load_fixtures(paths: list, store: AdvisoryStore = None)\
         store = AdvisoryStore()
     for path in paths:
         with open(path, "r", encoding="utf-8") as f:
-            docs = yaml.safe_load(f) or []
+            text = f.read()
+        try:
+            docs = yaml.safe_load(text) or []
+        except yaml.YAMLError:
+            # the reference's own fixtures carry go-yaml-tolerated
+            # quirks (trailing comma after a quoted list item);
+            # strip them and retry
+            cleaned = _re.sub(r'^(\s*- ".*"),\s*$', r"\1", text,
+                              flags=_re.MULTILINE)
+            docs = yaml.safe_load(cleaned) or []
         for top in docs:
             _load_bucket(store, top)
     return store

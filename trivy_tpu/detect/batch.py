@@ -153,6 +153,12 @@ def _compile(job: PairJob, sp: _RankSpace):
     if any(v == "" for v in list(job.vulnerable) + list(job.patched)):
         return [], [], 2                  # force-vulnerable
 
+    # node-semver's prerelease-exclusion rule is not an interval
+    # property; prerelease npm versions take the exact host path
+    if getattr(sp.comparer, "is_prerelease",
+               lambda v: False)(job.pkg_version):
+        raise _HostFallback
+
     vuln_ivs: list = []
     if job.vulnerable:
         flags |= 1

@@ -30,10 +30,27 @@ _PRIMARY_URL_PREFIXES = {
 
 
 def _sev_name(v) -> str:
-    if isinstance(v, int):
-        return str(SEVERITIES[v]) if 0 <= v < len(SEVERITIES) \
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        i = int(v)
+        return str(SEVERITIES[i]) if 0 <= i < len(SEVERITIES) \
             else "UNKNOWN"
     return str(v)
+
+
+def _rfc3339(v):
+    """YAML fixture dates parse to datetime; Go marshals time.Time as
+    RFC3339 with a Z suffix for UTC."""
+    if v is None or isinstance(v, str):
+        return v or None
+    s = v.isoformat()
+    if s.endswith("+00:00"):
+        s = s[:-6] + "Z"
+    elif getattr(v, "tzinfo", None) is None:
+        # naive datetimes and bare dates both marshal as UTC
+        if "T" not in s:
+            s += "T00:00:00"
+        s += "Z"
+    return s
 
 
 def fill_info(store, vulns: list) -> None:
@@ -58,8 +75,8 @@ def fill_info(store, vulns: list) -> None:
                              detail.vendor_severity.items()},
             cvss=detail.cvss,
             references=detail.references,
-            published_date=detail.published_date or None,
-            last_modified_date=detail.last_modified_date or None,
+            published_date=_rfc3339(detail.published_date),
+            last_modified_date=_rfc3339(detail.last_modified_date),
         )
         v.severity_source = severity_source
         v.primary_url = _primary_url(v.vulnerability_id,

@@ -169,5 +169,9 @@ def _patch_blobs(cache, artifacts, found) -> None:
         blob_id = a.reference.blob_ids[li]
         blob = cache.get_blob(blob_id)
         if blob is not None:
+            secrets.sort(key=lambda s: s.file_path)
+            for s in secrets:
+                s.findings.sort(key=lambda f: (f.rule_id,
+                                               f.start_line))
             blob.secrets = secrets
             cache.put_blob(blob_id, blob)

@@ -138,7 +138,7 @@ class SecretFinding:
     end_line: int = jfield("EndLine", default=0, keep=True)
     code: Code = jfield("Code", default_factory=Code, keep=True)
     match: str = jfield("Match", default="", keep=True)
-    deleted: bool = jfield("Deleted", default=False)
+    deleted: bool = jfield("Deleted", default=False, keep=True)
     layer: Layer = jfield("Layer", default_factory=Layer)
 
     def to_dict(self) -> dict:

@@ -29,7 +29,10 @@ class Vulnerability:
     last_modified_date: Optional[str] = jfield("LastModifiedDate", default=None)
 
     def to_dict(self) -> dict:
-        return asdict_omitempty(self)
+        d = asdict_omitempty(self)
+        # trivy-db tags VendorSeverity json:"-": internal only
+        d.pop("VendorSeverity", None)
+        return d
 
 
 @dataclass
@@ -210,6 +213,19 @@ class Result:
         return False
 
 
+# Go's encoding/json cannot omit an empty struct: Metadata.ImageConfig
+# (a v1.ConfigFile value) always serializes, as this zero value for
+# non-image scans (see any fs golden, e.g. integration/testdata/
+# pip.json.golden Metadata).
+EMPTY_IMAGE_CONFIG = {
+    "architecture": "",
+    "created": "0001-01-01T00:00:00Z",
+    "os": "",
+    "rootfs": {"type": "", "diff_ids": None},
+    "config": {},
+}
+
+
 @dataclass
 class Metadata:
     size: int = jfield("Size", default=0)
@@ -221,7 +237,10 @@ class Metadata:
     image_config: dict = jfield("ImageConfig", default_factory=dict)
 
     def to_dict(self) -> dict:
-        return asdict_omitempty(self)
+        d = asdict_omitempty(self)
+        d["ImageConfig"] = self.image_config or \
+            dict(EMPTY_IMAGE_CONFIG)
+        return d
 
 
 @dataclass

@@ -74,9 +74,20 @@ class MavenComparer(Comparer):
         if text[0] in "[(":
             return self._range(text)
         union = [ALWAYS]
-        for clause in re.split(r"[,\s]+", text):
-            if not clause:
-                continue
+        # ">= 2.0.0, <= 2.9.10.3": comma/space-separated comparator
+        # AND-list; whitespace between operator and version is legal
+        raw = [t for t in re.split(r"[,\s]+", text) if t]
+        clauses: list = []
+        i = 0
+        while i < len(raw):
+            tok = raw[i]
+            if tok in ("==", "!=", "<=", ">=", "<", ">", "=") and \
+                    i + 1 < len(raw):
+                tok += raw[i + 1]
+                i += 1
+            clauses.append(tok)
+            i += 1
+        for clause in clauses:
             union = intersect_unions(union, self._comparator(clause))
         return union
 

@@ -48,7 +48,30 @@ class NpmComparer(Comparer):
                          int(m.group("pat") or 0),
                          m.group("pre"))
 
+    def is_prerelease(self, s: str) -> bool:
+        try:
+            return self.parse(s)[1] == 0
+        except ValueError:
+            return False
+
     # --- ranges ---
+
+    @staticmethod
+    def _tokens(text: str) -> list:
+        """node-semver tolerates whitespace between an operator and
+        its version ("< 3.4.0"); rejoin such split tokens."""
+        raw = text.split()
+        out: list = []
+        i = 0
+        while i < len(raw):
+            tok = raw[i]
+            if tok in ("^", "~", "=", "<", "<=", ">", ">=") and \
+                    i + 1 < len(raw) and raw[i + 1] != "-":
+                tok += raw[i + 1]
+                i += 1
+            out.append(tok)
+            i += 1
+        return out
 
     def constraint_intervals(self, constraint: str) -> list:
         text = constraint.strip()
@@ -67,9 +90,42 @@ class NpmComparer(Comparer):
             return intersect_unions([lo_iv], [hi_iv])
 
         union = [ALWAYS]
-        for tok in text.split():
+        for tok in self._tokens(text):
             union = intersect_unions(union, self._comparator(tok))
         return union
+
+    # --- node-semver prerelease exclusion ---
+
+    def match(self, version: str, constraint: str) -> bool:
+        """node-semver: a prerelease version only satisfies a range
+        alternative if some comparator in it carries a prerelease on
+        the same major.minor.patch (go-npm-version follows this; the
+        reference's npm compare inherits it)."""
+        key = self.parse(version)
+        is_pre = key[1] == 0
+        result = False
+        for part in constraint.split("||"):
+            if not part.strip():
+                raise ValueError(
+                    f"empty constraint alternative in {constraint!r}")
+            if not any(iv.contains(key)
+                       for iv in self.constraint_intervals(part)):
+                continue
+            if is_pre and not self._pre_allowed(key[0], part):
+                continue
+            result = True
+        return result
+
+    def _pre_allowed(self, tuple3, part: str) -> bool:
+        for tok in re.split(r"\s+", part.strip()):
+            ver = tok.lstrip("^~=<>")
+            m = _VERSION_RE.match(ver)
+            if m and m.group("pre") is not None:
+                t = (int(m.group("maj")), int(m.group("min") or 0),
+                     int(m.group("pat") or 0))
+                if t == tuple3:
+                    return True
+        return False
 
     def _comparator(self, tok: str) -> list:
         m = re.match(r"^(\^|~|<=|>=|<|>|=|)\s*(.*)$", tok)
