@@ -77,6 +77,49 @@ def test_fs_golden(fixture, extra, golden, tmp_path, monkeypatch):
     assert ours == want
 
 
+@pytest.mark.parametrize("fixture,extra,golden",
+                         CASES[:4], ids=[c[0] for c in CASES[:4]])
+def test_fs_golden_compiled_db(fixture, extra, golden, tmp_path,
+                               monkeypatch):
+    """Same golden cases through the COMPILED advisory store
+    (TPU-resident tables path) — results must be identical."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "fs", f"testdata/fixtures/fs/{fixture}",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache", "--compile-db",
+        "--db-fixtures", _db_paths(), *extra])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))
+    want = norm(json.load(open(
+        os.path.join(REF, "testdata", golden))))
+    assert ours == want
+
+
+def test_db_build_and_scan_roundtrip(tmp_path, monkeypatch):
+    """trivy-tpu db build → --compiled-db scan produces golden
+    output."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    db_path = str(tmp_path / "compiled")
+    assert cli.main(["db", "build", "--from-fixtures", _db_paths(),
+                     "--output", db_path]) == 0
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "fs", "testdata/fixtures/fs/pip",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--compiled-db", db_path,
+        "--security-checks", "vuln", "--list-all-pkgs"])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))
+    want = norm(json.load(open(
+        os.path.join(REF, "testdata", "pip.json.golden"))))
+    assert ours == want
+
+
 def test_conan_packages_and_vuln(tmp_path, monkeypatch):
     """conan.json.golden is stale in the reference tree (it lacks the
     Metadata key and carries an unenriched vulnerability although

@@ -307,26 +307,31 @@ class PnpmLockAnalyzer(Analyzer):
         for sec in ("dependencies", "devDependencies",
                     "optionalDependencies"):
             direct.update((data.get(sec) or {}).keys())
+        try:
+            lock_ver = float(str(data.get("lockfileVersion", "5")))
+        except ValueError:
+            lock_ver = 5.0
         pkgs = []
         for key in (data.get("packages") or {}):
-            name, ver = self._split_key(key)
+            name, ver = self._split_key(key, lock_ver)
             if name and ver:
                 pkgs.append(_lib(name, ver,
                                  indirect=name not in direct))
         return _app("pnpm", path, pkgs)
 
     @staticmethod
-    def _split_key(key: str) -> tuple:
-        key = key.split("(")[0]          # v6 peer-dep suffixes
+    def _split_key(key: str, lock_ver: float) -> tuple:
+        """The lockfileVersion field picks the key syntax (as in
+        go-dep-parser): v5 '/name/ver_peersuffix' — the peer suffix
+        can itself contain '@' ('/react-dom/17.0.2_react@17.0.2') —
+        vs v6 '/name@ver(peer)(peer)'."""
         if not key.startswith("/"):
             return "", ""
-        key = key[1:]
-        if "@" in key[1:]:               # v6: /name@ver, /@scope/n@v
-            name, _, ver = key.rpartition("@")
+        if lock_ver >= 6:
+            body = key[1:].split("(")[0]
+            name, _, ver = body.rpartition("@")
             return name, ver
-        # v5: /name/ver or /@scope/name/ver, with optional peer-dep
-        # suffix after '_' ("/react-dom/17.0.2_react@17.0.2")
-        base, _, ver = key.rpartition("/")
+        base, _, ver = key[1:].rpartition("/")
         return base, ver.split("_")[0]
 
 

@@ -45,7 +45,11 @@ class SecretCandidateAnalyzer(Analyzer):
         ext = posixpath.splitext(name)[1].lower()
         if ext in SKIP_EXTS:
             return False
-        # the secret-rule config itself is never scanned
+        # the secret-rule config itself is never scanned; the
+        # reference compares basename(configPath) against the walked
+        # path (secret.go:135) — a deliberate quirk we replicate
+        # exactly (a top-level file merely SHARING the config's name
+        # is skipped there too)
         if self.config_path and \
                 posixpath.basename(self.config_path) == path:
             return False

@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["tpu", "cpu", "cpu-ref"])
         sp.add_argument("--db-fixtures", default="",
                         help="comma-separated advisory fixture YAMLs")
+        sp.add_argument("--compile-db", action="store_true",
+                        help="flatten the advisory store into "
+                        "TPU-resident tables before scanning")
+        sp.add_argument("--compiled-db", default="",
+                        help="load a compiled advisory DB "
+                        "(path prefix from 'trivy-tpu db build')")
         sp.add_argument("--secret-config", default="trivy-secret.yaml")
         sp.add_argument("--no-cache", action="store_true")
 
@@ -81,6 +87,16 @@ def build_parser() -> argparse.ArgumentParser:
     rootfs.add_argument("target")
     scan_flags(rootfs)
 
+    db = sub.add_parser("db", help="advisory DB operations")
+    dbsub = db.add_subparsers(dest="db_command")
+    build = dbsub.add_parser(
+        "build", help="compile fixtures into persistent TPU-resident "
+        "advisory tables")
+    build.add_argument("--from-fixtures", required=True,
+                       help="comma-separated advisory fixture YAMLs")
+    build.add_argument("--output", "-o", required=True,
+                       help="output path prefix (.npz/.pkl)")
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -94,18 +110,41 @@ def main(argv=None) -> int:
         return run_image(args)
     if args.command in ("filesystem", "fs", "rootfs"):
         return run_fs(args)
+    if args.command == "db":
+        return run_db(args)
     return 2
+
+
+def run_db(args) -> int:
+    if args.db_command != "build":
+        print("error: unknown db subcommand", file=sys.stderr)
+        return 2
+    from .db import CompiledDB
+    store = load_fixtures(
+        [p for p in args.from_fixtures.split(",") if p])
+    cdb = CompiledDB.compile(store)
+    cdb.save(args.output)
+    print(f"compiled {cdb.stats['rows']} advisories "
+          f"({cdb.stats['host_fallback_rows']} host-fallback) "
+          f"-> {args.output}.npz/.pkl")
+    return 0
 
 
 def _severities(arg: str) -> list:
     return [Severity.parse(s) for s in arg.split(",") if s.strip()]
 
 
-def _store(args) -> AdvisoryStore:
+def _store(args):
+    if getattr(args, "compiled_db", ""):
+        from .db import CompiledDB
+        return CompiledDB.load(args.compiled_db)
     store = AdvisoryStore()
     if args.db_fixtures:
         load_fixtures([p for p in args.db_fixtures.split(",") if p],
                       store)
+    if getattr(args, "compile_db", False):
+        from .db import CompiledDB
+        return CompiledDB.compile(store)
     return store
 
 

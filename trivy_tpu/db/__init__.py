@@ -10,6 +10,7 @@ and ``data-source`` metadata.
 
 from .store import Advisory, AdvisoryStore, VulnerabilityDetail
 from .fixtures import load_fixtures
+from .compiled import CompiledDB, SwappableStore
 
 __all__ = ["Advisory", "AdvisoryStore", "VulnerabilityDetail",
-           "load_fixtures"]
+           "load_fixtures", "CompiledDB", "SwappableStore"]

@@ -1,0 +1,438 @@
+"""Compiled, persistent, TPU-resident advisory tables.
+
+Round-1 rebuilt the rank universe from scratch on every dispatch
+(detect/batch._RankSpace), which is O(advisory universe) host work per
+scan — fine for fixtures, fatal at trivy-db scale. This module is the
+SURVEY §7 step-5 design: flatten the advisory store ONCE at DB-load
+time into
+
+  - per-grammar sorted bound-key universes (every constraint parsed
+    exactly once, at compile time);
+  - int32 interval tables [N, MAX_INTERVALS] in a doubled rank space
+    (bound = 2·rank + grammar band offset, exclusivity = ±1);
+  - a host-side name-join index bucket → package → row span;
+  - per-row metadata for DetectedVulnerability assembly;
+  - host-fallback rows for constraints the interval form can't carry
+    (> MAX_INTERVALS alternatives, parse errors, npm prereleases).
+
+At scan time, per-dispatch host work is O(packages): parse each
+distinct installed version once, binary-search its rank, gather
+candidate rows via the dict join — then ONE resident-table kernel
+dispatch (ops.intervals.interval_hits_resident) evaluates every
+(package, advisory) pair. The tables are pushed to device once and
+reused across scans; ``SwappableStore`` double-buffers them for hot
+swaps (reference: pkg/rpc/server/listen.go:71-80).
+
+Persistence: ``save``/``load`` round-trip the arrays (npz) plus the
+indexes/universes (pickle) so a compiled DB loads without re-parsing
+a single constraint.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from bisect import bisect_left
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..ops.intervals import MAX_INTERVALS, NEG_INF, POS_INF
+from ..utils import get_logger
+from ..vercmp import get_comparer
+from .store import Advisory, AdvisoryStore
+
+log = get_logger("db.compiled")
+
+# ecosystem prefix (before ::) → version grammar; mirrors
+# detector/library driver.go:24-67
+_ECO_GRAMMAR = {
+    "rubygems": "rubygems",
+    "cargo": "semver",
+    "composer": "semver",
+    "go": "semver",
+    "maven": "maven",
+    "npm": "npm",
+    "nuget": "semver",
+    "pip": "pep440",
+    "conan": "semver",
+}
+
+# OS bucket leading token → distro version grammar (detect/ospkg)
+_OS_GRAMMAR = {
+    "alpine": "apk",
+    "debian": "deb",
+    "ubuntu": "deb",
+    "amazon": "rpm",
+    "oracle": "rpm",
+    "alma": "rpm",
+    "rocky": "rpm",
+    "red": "rpm",           # "Red Hat"
+    "centos": "rpm",
+    "fedora": "rpm",
+    "cbl-mariner": "rpm",
+    "photon": "rpm",
+    "opensuse": "rpm",
+    "suse": "rpm",
+}
+
+# row flag bits (0-2 shared with ops.intervals)
+F_HAS_VULN = 1
+F_FORCE = 2
+F_HAS_SEC = 4
+F_HOST = 8            # evaluate on host (exact fallback)
+F_UNFIXED = 16        # os advisory without FixedVersion
+
+
+def bucket_grammar(bucket: str) -> Optional[str]:
+    if "::" in bucket:
+        return _ECO_GRAMMAR.get(bucket.split("::", 1)[0])
+    return _OS_GRAMMAR.get(bucket.split()[0].lower()) if bucket \
+        else None
+
+
+@dataclass
+class _Row:
+    bucket: str
+    pkg: str
+    advisory: Advisory
+    grammar: str
+    vuln_ivs: list = field(default_factory=list)
+    sec_ivs: list = field(default_factory=list)
+    flags: int = 0
+
+
+class CompiledDB:
+    """Flattened advisory tables + join index. Read-only after
+    ``compile`` / ``load``."""
+
+    def __init__(self):
+        self.rows_meta: list = []       # per row: (bucket, pkg, Advisory)
+        self.row_grammar: list = []
+        self.v_lo = self.v_hi = self.s_lo = self.s_hi = None
+        self.flags = None               # np.int32 [N]
+        self.index: dict = {}           # bucket → {pkg → [row ids]}
+        self.universe: dict = {}        # grammar → (keys list, base)
+        self.vulnerabilities: dict = {}
+        self.data_sources: dict = {}
+        self.stats: dict = {}
+        self._device: dict = {}
+        self._parse_cache: dict = {}
+
+    # ---- compile ----
+
+    @classmethod
+    def compile(cls, store: AdvisoryStore) -> "CompiledDB":
+        self = cls()
+        self.vulnerabilities = dict(store.vulnerabilities)
+        self.data_sources = dict(store.data_sources)
+
+        rows: list = []
+        n_host = 0
+        for bucket in sorted(store.buckets):
+            grammar = bucket_grammar(bucket)
+            for pkg in sorted(store.buckets[bucket]):
+                for adv in store.get(bucket, pkg):
+                    row = self._compile_row(bucket, pkg, adv, grammar)
+                    n_host += bool(row.flags & F_HOST)
+                    rows.append(row)
+
+        # per-grammar bound universes with disjoint band offsets
+        bounds: dict = {}
+        for row in rows:
+            for iv in row.vuln_ivs + row.sec_ivs:
+                b = bounds.setdefault(row.grammar, set())
+                if iv.lo is not None:
+                    b.add(iv.lo)
+                if iv.hi is not None:
+                    b.add(iv.hi)
+        base = 1
+        for grammar in sorted(bounds):
+            keys = sorted(bounds[grammar])
+            self.universe[grammar] = (keys, base)
+            base += 2 * len(keys) + 4
+
+        N = len(rows)
+        self.v_lo = np.full((N, MAX_INTERVALS), POS_INF, np.int32)
+        self.v_hi = np.full((N, MAX_INTERVALS), NEG_INF, np.int32)
+        self.s_lo = np.full((N, MAX_INTERVALS), POS_INF, np.int32)
+        self.s_hi = np.full((N, MAX_INTERVALS), NEG_INF, np.int32)
+        self.flags = np.zeros(N, np.int32)
+        for i, row in enumerate(rows):
+            self.flags[i] = row.flags
+            if row.flags & F_HOST:
+                continue
+            for j, iv in enumerate(row.vuln_ivs):
+                self.v_lo[i, j], self.v_hi[i, j] = \
+                    self._encode(row.grammar, iv)
+            for j, iv in enumerate(row.sec_ivs):
+                self.s_lo[i, j], self.s_hi[i, j] = \
+                    self._encode(row.grammar, iv)
+        self.rows_meta = [(r.bucket, r.pkg, r.advisory) for r in rows]
+        self.row_grammar = [r.grammar for r in rows]
+        for i, row in enumerate(rows):
+            self.index.setdefault(row.bucket, {}) \
+                .setdefault(row.pkg, []).append(i)
+
+        self.stats = {
+            "rows": N,
+            "host_fallback_rows": n_host,
+            "host_fallback_rate": (n_host / N) if N else 0.0,
+            "grammars": {g: len(k)
+                         for g, (k, _) in self.universe.items()},
+        }
+        log.info("compiled advisory db: %d rows, %d host-fallback "
+                 "(%.3f%%)", N, n_host,
+                 100.0 * self.stats["host_fallback_rate"])
+        return self
+
+    def _compile_row(self, bucket: str, pkg: str, adv: Advisory,
+                     grammar: Optional[str]) -> _Row:
+        row = _Row(bucket=bucket, pkg=pkg, advisory=adv,
+                   grammar=grammar or "generic")
+        is_ospkg = not (adv.vulnerable_versions or
+                        adv.patched_versions or
+                        adv.unaffected_versions)
+        # the unfixed marker survives host fallback so the driver's
+        # report_unfixed filter still applies (detect_pairs_resident)
+        unfixed = F_UNFIXED if is_ospkg and \
+            adv.fixed_version == "" else 0
+        if grammar is None:
+            row.flags = F_HOST | unfixed
+            return row
+        comparer = get_comparer(grammar)
+        try:
+            if is_ospkg:
+                self._compile_ospkg(row, comparer)
+            else:
+                self._compile_library(row, comparer)
+        except ValueError:
+            row.vuln_ivs, row.sec_ivs = [], []
+            row.flags = F_HOST | unfixed
+        return row
+
+    def _compile_library(self, row: _Row, comparer) -> None:
+        adv = row.advisory
+        if any(v == "" for v in
+               list(adv.vulnerable_versions) +
+               list(adv.patched_versions)):
+            row.flags = F_FORCE
+            return
+        if adv.vulnerable_versions:
+            row.flags |= F_HAS_VULN
+            for c in " || ".join(adv.vulnerable_versions).split("||"):
+                if not c.strip():
+                    raise ValueError("empty constraint alternative")
+                row.vuln_ivs.extend(comparer.constraint_intervals(c))
+        secure = list(adv.patched_versions) + \
+            list(adv.unaffected_versions)
+        if secure:
+            row.flags |= F_HAS_SEC
+            for c in " || ".join(secure).split("||"):
+                if not c.strip():
+                    raise ValueError("empty constraint alternative")
+                row.sec_ivs.extend(comparer.constraint_intervals(c))
+        if len(row.vuln_ivs) > MAX_INTERVALS or \
+                len(row.sec_ivs) > MAX_INTERVALS:
+            row.vuln_ivs, row.sec_ivs = [], []
+            row.flags = F_HOST
+
+    def _compile_ospkg(self, row: _Row, comparer) -> None:
+        from ..vercmp.base import Interval
+        adv = row.advisory
+        lo = comparer.parse(adv.affected_version) \
+            if adv.affected_version else None
+        if adv.fixed_version == "":
+            row.vuln_ivs = [Interval(lo=lo)]
+            row.flags = F_HAS_VULN | F_UNFIXED
+        else:
+            row.vuln_ivs = [Interval(
+                lo=lo, hi=comparer.parse(adv.fixed_version),
+                hi_incl=False)]
+            row.flags = F_HAS_VULN
+
+    def _encode(self, grammar: str, iv) -> tuple:
+        keys, base = self.universe[grammar]
+        if iv.lo is None:
+            lo = NEG_INF
+        else:
+            lo = base + 2 * bisect_left(keys, iv.lo) + \
+                (0 if iv.lo_incl else 1)
+        if iv.hi is None:
+            hi = POS_INF
+        else:
+            hi = base + 2 * bisect_left(keys, iv.hi) - \
+                (0 if iv.hi_incl else 1)
+        return lo, hi
+
+    # ---- scan-time API ----
+
+    def pkg_rank(self, grammar: str, version: str) -> Optional[int]:
+        """Rank an installed version in its grammar band. Bound keys
+        sit at even offsets; a version strictly between bounds gets
+        the odd offset below the next bound — containment is then
+        EXACT for bounds-only universes. None on parse failure."""
+        cached = self._parse_cache.get((grammar, version))
+        if cached is not None:
+            return cached if cached != -1 else None
+        keys, base = self.universe.get(grammar, ([], 1))
+        try:
+            key = get_comparer(grammar).parse(version)
+        except ValueError:
+            self._parse_cache[(grammar, version)] = -1
+            return None
+        i = bisect_left(keys, key)
+        if i < len(keys) and keys[i] == key:
+            r = base + 2 * i
+        else:
+            r = base + 2 * i - 1
+        self._parse_cache[(grammar, version)] = r
+        return r
+
+    def candidate_rows(self, bucket: str, pkg: str) -> list:
+        return self.index.get(bucket, {}).get(pkg, [])
+
+    def _prefix_index(self) -> dict:
+        """ecosystem prefix ("pip::") → bucket list, built lazily so
+        prefix joins are O(1) per package, not O(buckets)."""
+        if not hasattr(self, "_prefixes"):
+            prefixes: dict = {}
+            for bucket in self.index:
+                if "::" in bucket:
+                    pre = bucket.split("::", 1)[0] + "::"
+                    prefixes.setdefault(pre, []).append(bucket)
+            self._prefixes = prefixes
+        return self._prefixes
+
+    def candidate_rows_prefix(self, prefix: str, pkg: str) -> list:
+        buckets = self._prefix_index().get(prefix)
+        if buckets is None:               # non-ecosystem prefix query
+            buckets = [b for b in self.index if b.startswith(prefix)]
+        out = []
+        for bucket in buckets:
+            out.extend(self.index[bucket].get(pkg, []))
+        return out
+
+    def host_eval(self, row: int, version: str) -> bool:
+        """Exact host evaluation for F_HOST rows."""
+        from ..vercmp.base import is_vulnerable
+        bucket, _pkg, adv = self.rows_meta[row]
+        grammar = self.row_grammar[row]
+        if grammar == "generic":
+            grammar = bucket_grammar(bucket) or "semver"
+        comparer = get_comparer(grammar)
+        if adv.vulnerable_versions or adv.patched_versions or \
+                adv.unaffected_versions:
+            return is_vulnerable(comparer, version,
+                                 adv.vulnerable_versions,
+                                 adv.patched_versions,
+                                 adv.unaffected_versions)
+        if adv.fixed_version == "":
+            return True
+        try:
+            return comparer.compare(version, adv.fixed_version) < 0
+        except ValueError:
+            return False
+
+    # ---- device residency ----
+
+    def device_tables(self):
+        """Push tables to the default device once; reuse across
+        scans. Returns (v_lo, v_hi, s_lo, s_hi, flags) device arrays."""
+        import jax
+        key = "default"
+        if key not in self._device:
+            self._device[key] = tuple(
+                jax.device_put(a) for a in
+                (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
+                 self.flags))
+        return self._device[key]
+
+    # ---- enrichment reads (db.Config parity) ----
+
+    def get_vulnerability(self, vuln_id: str):
+        from .store import VulnerabilityDetail
+        v = self.vulnerabilities.get(vuln_id)
+        if v is None:
+            return None
+        return VulnerabilityDetail.from_dict(vuln_id, v)
+
+    # ---- persistence ----
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(
+            path + ".npz", v_lo=self.v_lo, v_hi=self.v_hi,
+            s_lo=self.s_lo, s_hi=self.s_hi, flags=self.flags)
+        with open(path + ".pkl", "wb") as f:
+            pickle.dump({
+                "rows_meta": self.rows_meta,
+                "row_grammar": self.row_grammar,
+                "index": self.index,
+                "universe": self.universe,
+                "vulnerabilities": self.vulnerabilities,
+                "data_sources": self.data_sources,
+                "stats": self.stats,
+            }, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @classmethod
+    def load(cls, path: str) -> "CompiledDB":
+        self = cls()
+        arrs = np.load(path + ".npz")
+        self.v_lo, self.v_hi = arrs["v_lo"], arrs["v_hi"]
+        self.s_lo, self.s_hi = arrs["s_lo"], arrs["s_hi"]
+        self.flags = arrs["flags"]
+        with open(path + ".pkl", "rb") as f:
+            d = pickle.load(f)
+        self.rows_meta = d["rows_meta"]
+        self.row_grammar = d["row_grammar"]
+        self.index = d["index"]
+        self.universe = d["universe"]
+        self.vulnerabilities = d["vulnerabilities"]
+        self.data_sources = d["data_sources"]
+        self.stats = d["stats"]
+        return self
+
+
+class SwappableStore:
+    """Double-buffered advisory DB holder (reference: the RW-waitgroup
+    pair gating the server's hourly DB update, listen.go:54-83).
+
+    Readers take ``current()`` under a shared lock; ``swap`` installs
+    a freshly compiled DB after in-flight scans drain. On TPU the old
+    device tables stay alive until their last reader finishes, then
+    get garbage-collected — the new tables are staged with
+    ``device_tables()`` BEFORE the swap so scans never wait on the
+    transfer."""
+
+    def __init__(self, db: Optional[CompiledDB] = None):
+        self._db = db
+        self._lock = threading.Lock()
+        self._readers = 0
+        self._no_readers = threading.Condition(self._lock)
+
+    def acquire(self) -> CompiledDB:
+        with self._lock:
+            self._readers += 1
+            return self._db
+
+    def release(self) -> None:
+        with self._lock:
+            self._readers -= 1
+            if self._readers == 0:
+                self._no_readers.notify_all()
+
+    def current(self) -> CompiledDB:
+        with self._lock:
+            return self._db
+
+    def swap(self, new_db: CompiledDB, stage: bool = True) -> None:
+        if stage and new_db.v_lo is not None and len(new_db.v_lo):
+            try:
+                new_db.device_tables()      # stage HBM copy up front
+            except Exception:               # no device available
+                pass
+        with self._lock:
+            while self._readers:
+                self._no_readers.wait()
+            self._db = new_db

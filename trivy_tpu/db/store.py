@@ -107,10 +107,14 @@ class AdvisoryStore:
     # --- reads (db.Config semantics) ---
 
     def get(self, bucket: str, pkg_name: str) -> list:
-        """Advisories for one package in one bucket."""
+        """Advisories for one package in one bucket. Non-dict values
+        (metadata buckets like "Red Hat CPE" repo→CPE maps) are not
+        advisories and are skipped."""
         out = []
         for vid, v in (self.buckets.get(bucket, {})
                        .get(pkg_name, {})).items():
+            if not isinstance(v, dict):
+                continue
             adv = Advisory.from_dict(vid, v)
             if adv.data_source is None:
                 adv.data_source = self._bucket_source(bucket)

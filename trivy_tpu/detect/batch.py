@@ -212,3 +212,85 @@ def _host_eval(job: PairJob) -> bool:
     comparer = get_comparer(job.grammar)
     return is_vulnerable(comparer, job.pkg_version, job.vulnerable,
                          job.patched, job.unaffected)
+
+
+# ---- compiled-store path (TPU-resident advisory tables) ----
+
+@dataclass
+class ResidentPairJob:
+    """(package, advisory-row) pair against a CompiledDB — no
+    constraint strings, no per-dispatch compilation."""
+
+    cdb: object                 # CompiledDB
+    row: int
+    grammar: str
+    pkg_version: str
+    report_unfixed: bool = True
+    payload: object = None
+
+
+def detect_pairs_resident(jobs: list, backend: str = "tpu") -> list:
+    """Evaluate ResidentPairJobs in one gather-dispatch against the
+    resident tables. Host work is O(jobs): rank lookups are cached
+    per (grammar, version); the advisory universe is never touched."""
+    if not jobs:
+        return []
+    from ..db.compiled import F_HOST, F_UNFIXED
+
+    cdb = jobs[0].cdb
+    out: list = []
+    kept: list = []
+    ranks: list = []
+    rows: list = []
+    host: list = []
+    for job in jobs:
+        flags = int(cdb.flags[job.row])
+        if (flags & F_UNFIXED) and not job.report_unfixed:
+            continue
+        comparer = get_comparer(job.grammar)
+        if (flags & F_HOST) or getattr(
+                comparer, "is_prerelease",
+                lambda v: False)(job.pkg_version):
+            host.append(job)
+            continue
+        r = cdb.pkg_rank(job.grammar, job.pkg_version)
+        if r is None:
+            continue                     # version parse error: skip
+        kept.append(job)
+        ranks.append(r)
+        rows.append(job.row)
+
+    if kept:
+        pkg_rank = np.asarray(ranks, np.int32)
+        row_idx = np.asarray(rows, np.int32)
+        if backend == "cpu-ref":
+            hits = interval_hits_host(
+                pkg_rank, cdb.v_lo[row_idx], cdb.v_hi[row_idx],
+                cdb.s_lo[row_idx], cdb.s_hi[row_idx],
+                cdb.flags[row_idx])
+        else:
+            import jax.numpy as jnp
+            from ..ops.intervals import interval_hits_resident
+            tables = cdb.device_tables()
+            hits = np.asarray(interval_hits_resident(
+                jnp.asarray(pkg_rank), jnp.asarray(row_idx), *tables))
+        out.extend(kept[i].payload for i in np.nonzero(hits)[0])
+
+    for job in host:
+        if job.cdb.host_eval(job.row, job.pkg_version):
+            out.append(job.payload)
+    return out
+
+
+def dispatch_jobs(jobs: list, backend: str = "tpu") -> list:
+    """Mixed-job dispatcher: classic PairJobs (per-dispatch compile)
+    and ResidentPairJobs (compiled store), each in one kernel call."""
+    plain = [j for j in jobs if isinstance(j, PairJob)]
+    resident = [j for j in jobs if isinstance(j, ResidentPairJob)]
+    out = detect_pairs(plain, backend=backend) if plain else []
+    by_db: dict = {}
+    for j in resident:
+        by_db.setdefault(id(j.cdb), []).append(j)
+    for js in by_db.values():
+        out.extend(detect_pairs_resident(js, backend=backend))
+    return out

@@ -50,6 +50,23 @@ def interval_hits_impl(pkg_rank: jax.Array, vuln_lo: jax.Array,
 interval_hits = jax.jit(interval_hits_impl)
 
 
+def interval_hits_resident_impl(pkg_rank: jax.Array,
+                                row_idx: jax.Array,
+                                vuln_lo: jax.Array, vuln_hi: jax.Array,
+                                sec_lo: jax.Array, sec_hi: jax.Array,
+                                flags: jax.Array) -> jax.Array:
+    """Resident-table variant: the [N, M] advisory tables live in HBM
+    across scans (compiled once at DB load — SURVEY §7 step 5); each
+    dispatch gathers only the candidate rows. [P] pkg ranks + [P] row
+    indices → [P] bool."""
+    return interval_hits_impl(pkg_rank, vuln_lo[row_idx],
+                              vuln_hi[row_idx], sec_lo[row_idx],
+                              sec_hi[row_idx], flags[row_idx])
+
+
+interval_hits_resident = jax.jit(interval_hits_resident_impl)
+
+
 def interval_hits_host(pkg_rank, vuln_lo, vuln_hi, sec_lo, sec_hi,
                        flags):
     """NumPy reference (differential testing)."""

@@ -29,7 +29,7 @@ from ..artifact.artifact import ArtifactOption, ImageArtifact
 from ..artifact.cache import MemoryCache
 from ..artifact.image import load_image
 from ..db import AdvisoryStore
-from ..detect.batch import detect_pairs
+from ..detect.batch import dispatch_jobs
 from ..scan.local import LocalScanner, ScanTarget
 from ..types import Metadata, Report, ScanOptions
 from ..utils import get_logger
@@ -110,8 +110,8 @@ class BatchScanRunner:
                 job.payload = (idx, job.payload)
                 all_jobs.append(job)
         detected_by_image: dict = {}
-        for idx, payload in detect_pairs(all_jobs,
-                                         backend=options.backend):
+        for idx, payload in dispatch_jobs(all_jobs,
+                                          backend=options.backend):
             detected_by_image.setdefault(idx, []).append(payload)
 
         # ---- phase 5: assemble per image ----

@@ -11,7 +11,9 @@ from typing import Optional
 
 from ..applier import apply_layers
 from ..db import AdvisoryStore
-from ..detect.batch import PairJob, detect_pairs
+from ..db.compiled import CompiledDB
+from ..detect.batch import (PairJob, ResidentPairJob, detect_pairs,
+                            dispatch_jobs)
 from ..detect.enrich import fill_info
 from ..detect.library import _TYPES as LIB_TYPES
 from ..detect.library import _fixed_versions, normalize_pkg_name
@@ -56,13 +58,15 @@ class LocalScanner:
     def __init__(self, cache, store: Optional[AdvisoryStore] = None):
         self.cache = cache
         self.store = store or AdvisoryStore()
+        self.compiled: Optional[CompiledDB] = \
+            store if isinstance(store, CompiledDB) else None
 
     def scan(self, target: ScanTarget, options: ScanOptions) -> tuple:
         """Returns (results, os) — single-target convenience around
         prepare + one kernel dispatch + finish."""
         prepared = self.prepare(target, options)
-        detected = detect_pairs(prepared.jobs,
-                                backend=options.backend)
+        detected = dispatch_jobs(prepared.jobs,
+                                 backend=options.backend)
         return self.finish(prepared, detected)
 
     def prepare(self, target: ScanTarget,
@@ -126,6 +130,7 @@ class LocalScanner:
         jobs: list = []
         eosl = False
 
+        cdb = self.compiled
         if "os" in options.vuln_type and detail.os is not None \
                 and detail.packages:
             driver = DRIVERS.get(detail.os.family)
@@ -135,6 +140,18 @@ class LocalScanner:
                                        detail.repository)
                 for pkg in detail.packages:
                     installed = driver.installed(pkg)
+                    if cdb is not None:
+                        for row in cdb.candidate_rows(
+                                bucket, driver.src_name(pkg)):
+                            adv = cdb.rows_meta[row][2]
+                            jobs.append(ResidentPairJob(
+                                cdb=cdb, row=row,
+                                grammar=driver.grammar,
+                                pkg_version=installed,
+                                report_unfixed=driver.report_unfixed,
+                                payload=("os", None, self._ospkg_vuln(
+                                    driver, pkg, installed, adv))))
+                        continue
                     for adv in self.store.get(bucket,
                                               driver.src_name(pkg)):
                         jobs.append(self._ospkg_job(
@@ -149,6 +166,17 @@ class LocalScanner:
                 eco, grammar = LIB_TYPES[app.type]
                 for lib in app.libraries:
                     name = normalize_pkg_name(eco, lib.name)
+                    if cdb is not None:
+                        for row in cdb.candidate_rows_prefix(
+                                f"{eco}::", name):
+                            adv = cdb.rows_meta[row][2]
+                            jobs.append(ResidentPairJob(
+                                cdb=cdb, row=row, grammar=grammar,
+                                pkg_version=lib.version,
+                                payload=("lib",
+                                         (app.type, app.file_path),
+                                         self._lib_vuln(lib, adv))))
+                        continue
                     for adv in self.store.get_advisories(
                             f"{eco}::", name):
                         jobs.append(self._lib_job(
@@ -198,7 +226,8 @@ class LocalScanner:
             ))
         return results
 
-    def _ospkg_job(self, driver, pkg, installed, adv) -> PairJob:
+    def _ospkg_vuln(self, driver, pkg, installed,
+                    adv) -> DetectedVulnerability:
         v = DetectedVulnerability(
             vulnerability_id=adv.vulnerability_id,
             vendor_ids=adv.vendor_ids,
@@ -215,6 +244,10 @@ class LocalScanner:
             v.vulnerability = Vulnerability(
                 severity=str(SEVERITIES[adv.severity])
                 if 0 <= adv.severity < 5 else "UNKNOWN")
+        return v
+
+    def _ospkg_job(self, driver, pkg, installed, adv) -> PairJob:
+        v = self._ospkg_vuln(driver, pkg, installed, adv)
         return PairJob(
             grammar=driver.grammar,
             pkg_version=installed,
@@ -225,8 +258,8 @@ class LocalScanner:
             payload=("os", None, v),
         )
 
-    def _lib_job(self, app, grammar, lib, adv) -> PairJob:
-        v = DetectedVulnerability(
+    def _lib_vuln(self, lib, adv) -> DetectedVulnerability:
+        return DetectedVulnerability(
             vulnerability_id=adv.vulnerability_id,
             pkg_id=lib.id,
             pkg_name=lib.name,
@@ -236,6 +269,9 @@ class LocalScanner:
             layer=lib.layer,
             data_source=adv.data_source,
         )
+
+    def _lib_job(self, app, grammar, lib, adv) -> PairJob:
+        v = self._lib_vuln(lib, adv)
         return PairJob(
             grammar=grammar,
             pkg_version=lib.version,

@@ -48,9 +48,17 @@ class _Parser:
                 return "".join(out)
             if c == "\\":
                 self.i += 1
+                if self.i >= len(t):
+                    raise self.error("unterminated escape")
                 e = t[self.i]
                 if e == "u":
-                    out.append(chr(int(t[self.i + 1:self.i + 5], 16)))
+                    hexs = t[self.i + 1:self.i + 5]
+                    if len(hexs) < 4:
+                        raise self.error("truncated \\u escape")
+                    try:
+                        out.append(chr(int(hexs, 16)))
+                    except ValueError:
+                        raise self.error("bad \\u escape") from None
                     self.i += 5
                 else:
                     out.append({"n": "\n", "t": "\t", "r": "\r",
