@@ -1,0 +1,335 @@
+"""rpmdb readers (BDB / SQLite / NDB) + rpm analyzer + e2e centos
+scan.
+
+No binary rpmdb fixtures exist in the reference checkout (its
+integration images are pulled at CI time), so fixtures are built
+here from the published formats: rpm header blobs (tag/type/offset/
+count index + data), libdb hash pages, rpm's sqlite schema, and
+SUSE's NDB layout.
+"""
+
+import io
+import sqlite3
+import struct
+import tempfile
+import os
+
+import pytest
+
+from trivy_tpu.rpmdb import (bdb_blobs, list_packages, ndb_blobs,
+                             parse_header_blob, sqlite_blobs)
+from trivy_tpu.rpmdb.header import (TAG_ARCH, TAG_EPOCH, TAG_LICENSE,
+                                    TAG_NAME, TAG_RELEASE,
+                                    TAG_SOURCERPM, TAG_VENDOR,
+                                    TAG_VERSION)
+
+# ---- fixture builders ----
+
+
+def make_header(name, version, release, arch="x86_64", epoch=None,
+                sourcerpm="", vendor="CentOS", license_="MIT"):
+    """Build an rpm header blob: index entries + data section."""
+    entries = []          # (tag, type, data_bytes, count)
+
+    def add_str(tag, s):
+        entries.append((tag, 6, s.encode() + b"\x00", 1))
+
+    def add_i32(tag, v):
+        entries.append((tag, 4, struct.pack(">i", v), 1))
+
+    add_str(TAG_NAME, name)
+    add_str(TAG_VERSION, version)
+    add_str(TAG_RELEASE, release)
+    if epoch is not None:
+        add_i32(TAG_EPOCH, epoch)
+    add_str(TAG_ARCH, arch)
+    if sourcerpm:
+        add_str(TAG_SOURCERPM, sourcerpm)
+    add_str(TAG_VENDOR, vendor)
+    add_str(TAG_LICENSE, license_)
+
+    data = bytearray()
+    index = bytearray()
+    for tag, typ, payload, count in entries:
+        if typ == 4:            # int32 aligns to 4
+            while len(data) % 4:
+                data += b"\x00"
+        index += struct.pack(">iIiI", tag, typ, len(data), count)
+        data += payload
+    return struct.pack(">ii", len(entries), len(data)) + \
+        bytes(index) + bytes(data)
+
+
+PAGE = 4096
+
+
+def make_bdb(blobs):
+    """Minimal libdb hash file: meta page + one page per record
+    (overflow chains for blobs too big for one page)."""
+    pages = [bytearray(PAGE)]           # meta placeholder
+
+    def new_page(ptype, prev=0, nxt=0, entries=0, hf_offset=0):
+        p = bytearray(PAGE)
+        struct.pack_into("<I", p, 8, len(pages))      # pgno
+        struct.pack_into("<I", p, 12, prev)
+        struct.pack_into("<I", p, 16, nxt)
+        struct.pack_into("<H", p, 20, entries)
+        struct.pack_into("<H", p, 22, hf_offset)
+        p[25] = ptype
+        pages.append(p)
+        return p
+
+    for i, blob in enumerate(blobs):
+        key = struct.pack("<I", i + 1)
+        inline_room = PAGE - 26 - 4 - (1 + len(key)) - 1 - 12
+        if len(blob) <= inline_room:
+            p = new_page(2, entries=2)
+            off0 = PAGE - (1 + len(key))
+            p[off0] = 1                      # H_KEYDATA
+            p[off0 + 1:off0 + 1 + len(key)] = key
+            off1 = off0 - (1 + len(blob))
+            p[off1] = 1
+            p[off1 + 1:off1 + 1 + len(blob)] = blob
+            struct.pack_into("<H", p, 26, off0)
+            struct.pack_into("<H", p, 28, off1)
+        else:
+            # data on overflow chain
+            first_ov = len(pages) + 1
+            p = new_page(2, entries=2)
+            off0 = PAGE - (1 + len(key))
+            p[off0] = 1
+            p[off0 + 1:off0 + 1 + len(key)] = key
+            off1 = off0 - 12
+            p[off1] = 3                      # H_OFFPAGE
+            struct.pack_into("<I", p, off1 + 4, first_ov)
+            struct.pack_into("<I", p, off1 + 8, len(blob))
+            struct.pack_into("<H", p, 26, off0)
+            struct.pack_into("<H", p, 28, off1)
+            pos = 0
+            while pos < len(blob):
+                chunk = blob[pos:pos + (PAGE - 26)]
+                pos += len(chunk)
+                nxt = len(pages) + 1 if pos < len(blob) else 0
+                ov = new_page(7, nxt=nxt, hf_offset=len(chunk))
+                ov[26:26 + len(chunk)] = chunk
+
+    meta = pages[0]
+    struct.pack_into("<I", meta, 12, 0x061561)    # hash magic
+    struct.pack_into("<I", meta, 16, 9)           # version
+    struct.pack_into("<I", meta, 20, PAGE)
+    struct.pack_into("<I", meta, 32, len(pages) - 1)   # last_pgno
+    return b"".join(bytes(p) for p in pages)
+
+
+def make_sqlite(blobs):
+    fd, path = tempfile.mkstemp()
+    os.close(fd)
+    try:
+        con = sqlite3.connect(path)
+        con.execute("CREATE TABLE Packages "
+                    "(hnum INTEGER PRIMARY KEY, blob BLOB)")
+        for i, b in enumerate(blobs):
+            con.execute("INSERT INTO Packages VALUES (?, ?)",
+                        (i + 1, b))
+        con.commit()
+        con.close()
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        os.unlink(path)
+
+
+def make_ndb(blobs):
+    header = struct.pack("<IIII", 0x506D7052, 0, 1, 1)
+    slots = bytearray()
+    blob_area = bytearray()
+    blob_start = PAGE                    # one slot page
+    for i, b in enumerate(blobs):
+        blkoff = (blob_start + len(blob_area)) // 16
+        slots += struct.pack("<IIII", 0x746F6C53, i + 1, blkoff,
+                             (16 + len(b) + 15) // 16)
+        blob_area += struct.pack("<IIII", 0x53626C42, i + 1, 0,
+                                 len(b))
+        blob_area += b
+        while len(blob_area) % 16:
+            blob_area += b"\x00"
+    page = header + bytes(slots)
+    page += b"\x00" * (PAGE - len(page))
+    return page + bytes(blob_area)
+
+
+SAMPLE = [
+    ("openssl-libs", "1.1.1c", "2.el8", 1,
+     "openssl-1.1.1c-2.el8.src.rpm"),
+    ("bash", "4.4.19", "10.el8", None, "bash-4.4.19-10.el8.src.rpm"),
+    ("glibc", "2.28", "101.el8", None, "glibc-2.28-101.el8.src.rpm"),
+]
+
+
+def _blobs():
+    return [make_header(n, v, r, epoch=e, sourcerpm=s)
+            for n, v, r, e, s in SAMPLE]
+
+
+# ---- header parsing ----
+
+def test_header_roundtrip():
+    pkg = parse_header_blob(make_header(
+        "openssl-libs", "1.1.1c", "2.el8", epoch=1,
+        sourcerpm="openssl-1.1.1c-2.el8.src.rpm"))
+    assert pkg.name == "openssl-libs"
+    assert pkg.version == "1.1.1c"
+    assert pkg.release == "2.el8"
+    assert pkg.epoch == 1
+    assert pkg.arch == "x86_64"
+    assert pkg.src_fields == ("openssl", "1.1.1c", "2.el8")
+    assert pkg.license == "MIT"
+
+
+# ---- container formats ----
+
+@pytest.mark.parametrize("maker,reader", [
+    (make_bdb, bdb_blobs),
+    (make_sqlite, sqlite_blobs),
+    (make_ndb, ndb_blobs),
+], ids=["bdb", "sqlite", "ndb"])
+def test_container_roundtrip(maker, reader):
+    blobs = _blobs()
+    got = reader(maker(blobs))
+    assert [parse_header_blob(b).name for b in got] == \
+        [n for n, *_ in SAMPLE]
+
+
+def test_bdb_overflow_chain():
+    big = make_header("giant", "1.0", "1",
+                      sourcerpm="giant-1.0-1.src.rpm",
+                      license_="X" * 9000)
+    assert len(big) > PAGE
+    got = bdb_blobs(make_bdb([big]))
+    assert got == [big]
+    assert parse_header_blob(got[0]).name == "giant"
+
+
+def test_list_packages_sniffs_format():
+    for maker in (make_bdb, make_sqlite, make_ndb):
+        pkgs = list_packages(maker(_blobs()))
+        assert [p.name for p in pkgs] == [n for n, *_ in SAMPLE]
+
+
+# ---- end-to-end: centos image scan through the interval kernel ----
+
+def test_centos_image_scan_rpm_vulns(tmp_path):
+    import json
+    from tests.test_e2e_image import make_image_tar, run_cli
+
+    os_release = (b'NAME="CentOS Linux"\nID="centos"\n'
+                  b'VERSION_ID="8"\n')
+    tar = make_image_tar(tmp_path, [
+        {"etc/os-release": os_release,
+         "var/lib/rpm/Packages": make_bdb(_blobs())},
+    ])
+    fixtures = tmp_path / "db.yaml"
+    fixtures.write_text("""
+- bucket: Red Hat
+  pairs:
+    - bucket: openssl
+      pairs:
+        - key: CVE-2020-1971
+          value: {FixedVersion: "1:1.1.1g-12.el8_3", Severity: 3}
+    - bucket: bash
+      pairs:
+        - key: CVE-2019-18276
+          value: {FixedVersion: "", Severity: 1}
+- bucket: vulnerability
+  pairs:
+    - key: CVE-2020-1971
+      value: {Title: "openssl NULL deref", Severity: HIGH}
+    - key: CVE-2019-18276
+      value: {Title: "bash privilege escalation", Severity: LOW}
+""")
+    out = tmp_path / "r.json"
+    code, _ = run_cli([
+        "image", "--input", tar, "--format", "json",
+        "--output", str(out), "--security-checks", "vuln",
+        "--backend", "cpu", "--no-cache",
+        "--db-fixtures", str(fixtures)])
+    assert code == 0
+    report = json.loads(out.read_text())
+    res = [r for r in report["Results"] if r["Class"] == "os-pkgs"]
+    assert res and res[0]["Type"] == "centos"
+    ids = {v["VulnerabilityID"]: v for r in res
+           for v in r.get("Vulnerabilities") or []}
+    # fixed advisory: installed 1:1.1.1c-2.el8 < 1:1.1.1g-12.el8_3
+    assert "CVE-2020-1971" in ids
+    assert ids["CVE-2020-1971"]["PkgName"] == "openssl-libs"
+    # unfixed advisory reported (redhat reports unfixed)
+    assert "CVE-2019-18276" in ids
+    assert ids["CVE-2019-18276"].get("FixedVersion", "") == ""
+
+
+def test_centos_image_scan_compiled_db(tmp_path):
+    """Same scan through the compiled store must agree."""
+    import json
+    from tests.test_e2e_image import make_image_tar, run_cli
+    os_release = (b'NAME="CentOS Linux"\nID="centos"\n'
+                  b'VERSION_ID="8"\n')
+    tar = make_image_tar(tmp_path, [
+        {"etc/os-release": os_release,
+         "var/lib/rpm/rpmdb.sqlite": make_sqlite(_blobs())},
+    ])
+    fixtures = tmp_path / "db.yaml"
+    fixtures.write_text("""
+- bucket: Red Hat
+  pairs:
+    - bucket: openssl
+      pairs:
+        - key: CVE-2020-1971
+          value: {FixedVersion: "1:1.1.1g-12.el8_3", Severity: 3}
+""")
+    out = tmp_path / "r.json"
+    code, _ = run_cli([
+        "image", "--input", tar, "--format", "json",
+        "--output", str(out), "--security-checks", "vuln",
+        "--backend", "cpu", "--no-cache", "--compile-db",
+        "--db-fixtures", str(fixtures)])
+    assert code == 0
+    report = json.loads(out.read_text())
+    vulns = [v for r in report["Results"]
+             for v in r.get("Vulnerabilities") or []]
+    assert [v["VulnerabilityID"] for v in vulns] == ["CVE-2020-1971"]
+
+
+def test_rpm_eol_tables():
+    from trivy_tpu.detect.ospkg.drivers import DRIVERS
+    import datetime
+    now = datetime.datetime(2026, 7, 1,
+                            tzinfo=datetime.timezone.utc)
+    assert not DRIVERS["amazon"].is_supported("2", now=now)
+    assert not DRIVERS["centos"].is_supported("8", now=now)
+    assert DRIVERS["redhat"].is_supported("9", now=now)
+    assert DRIVERS["oracle"].is_supported("8.5", now=now)
+    assert not DRIVERS["opensuse.leap"].is_supported("15.1", now=now)
+
+
+def test_rpmqa_manifest_parses_sourcerpm():
+    from trivy_tpu.analyzer.rpm import RpmQaAnalyzer
+    line = ("openssl-libs\t1.1.1k-21.cm2\t1670000000\t1660000000\t"
+            "Microsoft Corporation\t(none)\t123456\tx86_64\t0\t"
+            "openssl-1.1.1k-21.cm2.src.rpm\n")
+    res = RpmQaAnalyzer().analyze(
+        "var/lib/rpmmanifest/container-manifest-2", line.encode())
+    pkg = res.package_infos[0].packages[0]
+    assert (pkg.name, pkg.version, pkg.release) == \
+        ("openssl-libs", "1.1.1k", "21.cm2")
+    assert pkg.src_name == "openssl"      # advisory join key
+    assert pkg.arch == "x86_64"
+
+
+def test_redhat_eol_key_strips_minor():
+    from trivy_tpu.detect.ospkg.drivers import DRIVERS
+    import datetime
+    now = datetime.datetime(2026, 7, 1,
+                            tzinfo=datetime.timezone.utc)
+    assert not DRIVERS["centos"].is_supported("8.4.2105", now=now)
+    assert not DRIVERS["amazon"].is_supported("2018.03", now=now)
+    assert not DRIVERS["amazon"].is_supported("2 (Karoo)", now=now)

@@ -18,6 +18,7 @@ from . import apk  # noqa: F401
 from . import dpkg  # noqa: F401
 from . import secret  # noqa: F401
 from . import language  # noqa: F401
+from . import rpm  # noqa: F401
 
 __all__ = ["Analyzer", "AnalysisResult", "AnalyzerGroup",
            "register_analyzer", "registered_analyzers"]

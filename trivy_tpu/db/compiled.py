@@ -45,19 +45,12 @@ from .store import Advisory, AdvisoryStore
 
 log = get_logger("db.compiled")
 
-# ecosystem prefix (before ::) → version grammar; mirrors
-# detector/library driver.go:24-67
-_ECO_GRAMMAR = {
-    "rubygems": "rubygems",
-    "cargo": "semver",
-    "composer": "semver",
-    "go": "semver",
-    "maven": "maven",
-    "npm": "npm",
-    "nuget": "semver",
-    "pip": "pep440",
-    "conan": "semver",
-}
+def _eco_grammar() -> dict:
+    """ecosystem prefix (before ::) → version grammar, derived from
+    the single source of truth in detect.library._TYPES (lazy to
+    avoid a circular import through trivy_tpu.db)."""
+    from ..detect.library import _TYPES
+    return {eco: grammar for eco, grammar in _TYPES.values()}
 
 # OS bucket leading token → distro version grammar (detect/ospkg)
 _OS_GRAMMAR = {
@@ -87,7 +80,7 @@ F_UNFIXED = 16        # os advisory without FixedVersion
 
 def bucket_grammar(bucket: str) -> Optional[str]:
     if "::" in bucket:
-        return _ECO_GRAMMAR.get(bucket.split("::", 1)[0])
+        return _eco_grammar().get(bucket.split("::", 1)[0])
     return _OS_GRAMMAR.get(bucket.split()[0].lower()) if bucket \
         else None
 
@@ -315,7 +308,8 @@ class CompiledDB:
         return out
 
     def host_eval(self, row: int, version: str) -> bool:
-        """Exact host evaluation for F_HOST rows."""
+        """Exact host evaluation for F_HOST rows — must mirror the
+        classic paths (base.is_vulnerable / Driver._is_vulnerable)."""
         from ..vercmp.base import is_vulnerable
         bucket, _pkg, adv = self.rows_meta[row]
         grammar = self.row_grammar[row]
@@ -328,6 +322,15 @@ class CompiledDB:
                                  adv.vulnerable_versions,
                                  adv.patched_versions,
                                  adv.unaffected_versions)
+        # ospkg: affected-version gate first (alpine "introduced in");
+        # a parse error rejects, as in Driver._is_vulnerable
+        if adv.affected_version:
+            try:
+                if comparer.parse(adv.affected_version) > \
+                        comparer.parse(version):
+                    return False
+            except ValueError:
+                return False
         if adv.fixed_version == "":
             return True
         try:

@@ -122,10 +122,16 @@ class Driver:
 
     # --- support window ---
 
+    def eol_key(self, os_ver: str) -> str:
+        """Version key for the EOL table; defaults to the bucket
+        normalization but may differ (redhat.go:212-214 strips to the
+        major even though its bucket is flat)."""
+        return self.normalize_ver(os_ver)
+
     def is_supported(self, os_ver: str, now=None) -> bool:
         if not self.eol:
             return True
-        eol = self.eol.get(self.normalize_ver(os_ver))
+        eol = self.eol.get(self.eol_key(os_ver))
         if eol is None:
             return True            # may be the latest version
         now = now or datetime.datetime.now(datetime.timezone.utc)
@@ -206,6 +212,64 @@ UBUNTU_EOL = {
 }
 
 
+# EOL tables for the rpm families (factual constants from the
+# reference detectors: amazon.go:21-26, oracle.go:22-29, alma.go:21-24,
+# rocky.go:21-24, redhat.go:45-63, photon.go:18-25, suse.go:21-60)
+AMAZON_EOL = {
+    "1": _D(2023, 6, 30), "2": _D(2024, 6, 30),
+    "2022": _D(3000, 1, 1),
+}
+
+ORACLE_EOL = {
+    "3": _D(2011, 12, 31), "4": _D(2013, 12, 31),
+    "5": _D(2017, 12, 31), "6": _D(2021, 3, 21),
+    "7": _D(2024, 7, 23), "8": _D(2029, 7, 18),
+    "9": _D(2032, 7, 18),
+}
+
+ALMA_EOL = {"8": _D(2029, 3, 1), "9": _D(2032, 5, 31)}
+
+ROCKY_EOL = {"8": _D(2029, 5, 31), "9": _D(2032, 5, 31)}
+
+REDHAT_EOL = {
+    "4": _D(2017, 5, 31), "5": _D(2020, 11, 30),
+    "6": _D(2024, 6, 30), "7": _D(3000, 1, 1),
+    "8": _D(3000, 1, 1), "9": _D(3000, 1, 1),
+}
+
+CENTOS_EOL = {
+    "3": _D(2010, 10, 31), "4": _D(2012, 2, 29),
+    "5": _D(2017, 3, 31), "6": _D(2020, 11, 30),
+    "7": _D(2024, 6, 30), "8": _D(2021, 12, 31),
+}
+
+PHOTON_EOL = {
+    "1.0": _D(2022, 2, 28), "2.0": _D(2022, 12, 31),
+    "3.0": _D(2024, 6, 30), "4.0": _D(2025, 12, 31),
+}
+
+SLES_EOL = {
+    "10": _D(2007, 12, 31), "10.1": _D(2008, 11, 30),
+    "10.2": _D(2010, 4, 11), "10.3": _D(2011, 10, 11),
+    "10.4": _D(2013, 7, 31), "11": _D(2010, 12, 31),
+    "11.1": _D(2012, 8, 31), "11.2": _D(2014, 1, 31),
+    "11.3": _D(2016, 1, 31), "11.4": _D(2019, 3, 31),
+    "12": _D(2016, 6, 30), "12.1": _D(2017, 5, 31),
+    "12.2": _D(2018, 3, 31), "12.3": _D(2019, 1, 30),
+    "12.4": _D(2020, 6, 30), "12.5": _D(2024, 10, 31),
+    "15": _D(2019, 12, 31), "15.1": _D(2021, 1, 31),
+    "15.2": _D(2021, 12, 31), "15.3": _D(2022, 12, 31),
+    "15.4": _D(2028, 12, 31),
+}
+
+OPENSUSE_EOL = {
+    "42.1": _D(2017, 5, 17), "42.2": _D(2018, 1, 26),
+    "42.3": _D(2019, 6, 30), "15.0": _D(2019, 12, 3),
+    "15.1": _D(2020, 11, 30), "15.2": _D(2021, 11, 30),
+    "15.3": _D(2022, 11, 30), "15.4": _D(2023, 11, 30),
+}
+
+
 class _RedHat(Driver):
     """Red Hat / CentOS (reference: pkg/detector/ospkg/redhat).
 
@@ -217,6 +281,20 @@ class _RedHat(Driver):
     def bucket(self, os_ver: str, repo) -> str:
         return "Red Hat"
 
+    def eol_key(self, os_ver: str) -> str:
+        # "8.4.2105" → "8" (redhat.go:212-214)
+        return os_ver.split(".")[0]
+
+
+class _Amazon(_MajorOnly):
+    def eol_key(self, os_ver: str) -> str:
+        # amazon.go:121-124: first field; anything that isn't a known
+        # stream is Amazon Linux 1 ("2018.03" etc.)
+        ver = os_ver.split()[0] if os_ver.split() else os_ver
+        if ver not in self.eol:
+            ver = "1" if ver != "2" else ver
+        return ver
+
 
 DRIVERS = {
     "alpine": _Alpine("alpine", "apk", "alpine {ver}",
@@ -227,29 +305,35 @@ DRIVERS = {
     "ubuntu": Driver("ubuntu", "deb", "ubuntu {ver}",
                      severity_source="ubuntu",
                      report_unfixed=True, eol=UBUNTU_EOL),
-    "amazon": _MajorOnly("amazon", "rpm", "amazon linux {ver}",
-                         severity_source="amazon",
-                         report_unfixed=False),
+    "amazon": _Amazon("amazon", "rpm", "amazon linux {ver}",
+                      severity_source="amazon",
+                      report_unfixed=False, eol=AMAZON_EOL),
     "oracle": _MajorOnly("oracle", "rpm", "Oracle Linux {ver}",
-                         report_unfixed=False),
+                         report_unfixed=False, eol=ORACLE_EOL),
     "alma": _MajorOnly("alma", "rpm", "alma {ver}",
-                       severity_source="alma", report_unfixed=False),
+                       severity_source="alma", report_unfixed=False,
+                       eol=ALMA_EOL),
     "rocky": _MajorOnly("rocky", "rpm", "rocky {ver}",
-                        severity_source="rocky", report_unfixed=False),
+                        severity_source="rocky", report_unfixed=False,
+                        eol=ROCKY_EOL),
     "redhat": _RedHat("redhat", "rpm", "Red Hat",
-                      severity_source="redhat", report_unfixed=True),
+                      severity_source="redhat", report_unfixed=True,
+                      eol=REDHAT_EOL),
     "centos": _RedHat("centos", "rpm", "Red Hat",
-                      severity_source="redhat", report_unfixed=True),
+                      severity_source="redhat", report_unfixed=True,
+                      eol=CENTOS_EOL),
     "cbl-mariner": Driver("cbl-mariner", "rpm", "CBL-Mariner {ver}",
                           report_unfixed=True),
     "photon": Driver("photon", "rpm", "Photon OS {ver}",
-                     severity_source="photon", report_unfixed=True),
+                     severity_source="photon", report_unfixed=True,
+                     eol=PHOTON_EOL),
     "opensuse.leap": Driver("opensuse.leap", "rpm",
                             "openSUSE Leap {ver}",
-                            report_unfixed=False),
+                            report_unfixed=False, eol=OPENSUSE_EOL),
     "suse linux enterprise server": Driver(
         "suse linux enterprise server", "rpm",
-        "SUSE Linux Enterprise {ver}", report_unfixed=False),
+        "SUSE Linux Enterprise {ver}", report_unfixed=False,
+        eol=SLES_EOL),
 }
 
 
