@@ -83,12 +83,13 @@ class TestElastic:
             parse("€tok[0-9]{6}")
         with _pt.raises(RegexParseError):
             parse("[é-ü]x")
-        # and the rule pack routes such rules to host fallback
+        # and the scan plan routes such rules to the unanchored
+        # whole-file path (gate-only) instead of failing
         from trivy_tpu.secret.model import Rule, compile_rx
-        from trivy_tpu.secret.rx.pack import compile_rules
-        pack = compile_rules([Rule(id="euro",
-                                   regex=compile_rx("€tok[0-9]{6}"))])
-        assert pack.fallback_rules == [0]
+        from trivy_tpu.secret.plan import build_scan_plan
+        plan = build_scan_plan([Rule(id="euro",
+                                     regex=compile_rx("€tok[0-9]{6}"))])
+        assert not plan.rules[0].anchored
 
     def test_interior_space_not_elastic(self):
         ra = analyze_rule(r"key\s*=\s*[0-9]{4}")

@@ -5,10 +5,10 @@ A brand-new framework with the capabilities of the reference scanner
 Kubernetes scanning for vulnerabilities, secrets, misconfigurations and
 licenses — with the two hot loops re-designed TPU-first:
 
-* secret detection: multi-pattern regex matching compiled to DFAs and
-  batch-executed on TPU over flattened, segment-padded byte buffers
-  (``trivy_tpu.ops.dfa``), with sparse host-side verification for exact
-  span/group parity;
+* secret detection: a batched literal/anchor sieve over flattened,
+  segment-padded byte buffers (``trivy_tpu.ops.keywords``) plus a
+  class-run gate kernel (``trivy_tpu.ops.runs``), with sparse
+  host-side verification for exact span/group parity;
 * vulnerability detection: package→advisory version-constraint matching
   as vectorized fixed-width version-key interval intersection
   (``trivy_tpu.ops.vercmp``) over a flattened advisory table.

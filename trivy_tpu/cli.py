@@ -1,8 +1,8 @@
 """Command-line interface (reference: pkg/commands/app.go).
 
 Subcommands mirror the reference's cobra tree: image, filesystem
-(alias fs), rootfs, sbom, server, version — flags follow the same
-names so invocations port over (``--severity``, ``--security-checks``,
+(alias fs), rootfs, db build, version — flags follow the same names
+so invocations port over (``--severity``, ``--security-checks``,
 ``--format``, ``--ignore-unfixed``, ``--skip-dirs`` …), plus
 ``--backend tpu|cpu|cpu-ref`` selecting the kernel dispatch path.
 """

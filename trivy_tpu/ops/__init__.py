@@ -1,10 +1,12 @@
 """TPU kernels (JAX/XLA, with Pallas variants where they win).
 
-* ``dfa``    — batched multi-pattern DFA scanning (secret detection).
-* ``vercmp`` — vectorized version-constraint matching (vulnerability
-  detection).
+* ``keywords``  — literal/anchor blockmask sieve (secret detection;
+  Pallas variant in ``keywords_pallas``).
+* ``runs``      — mandatory class-run gate (secret detection).
+* ``intervals`` — vectorized version-interval membership
+  (vulnerability detection).
 """
 
-from . import dfa  # noqa: F401
+from . import keywords, runs, intervals  # noqa: F401
 
-__all__ = ["dfa"]
+__all__ = ["keywords", "runs", "intervals"]

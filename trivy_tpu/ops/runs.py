@@ -12,6 +12,7 @@ the plan).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
@@ -59,8 +60,11 @@ def _erode(m: jax.Array, R: int) -> jax.Array:
     return e
 
 
+@functools.lru_cache(maxsize=16)
 def make_run_hits(specs: tuple):
-    """Compile a jitted [B, L] → [B, n_specs] bool run detector."""
+    """Compile a jitted [B, L] → [B, n_specs] bool run detector.
+    Cached on the (hashable) spec tuple so every scanner instance
+    built from the same rule set shares one compiled kernel."""
 
     @jax.jit
     def run_hits(segments: jax.Array) -> jax.Array:

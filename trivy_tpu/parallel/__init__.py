@@ -7,13 +7,12 @@ those axes onto a `jax.sharding.Mesh`:
   - ``data``  axis: segments / files / packages — the reference's
     per-layer and per-file goroutine fan-out becomes batch-dimension
     data parallelism over ICI.
-  - ``rules`` axis: DFA rule-groups / advisory shards — the 83-rule scan
-    loop becomes tensor-style parallelism over automaton tables, with an
-    ``all_gather`` to rejoin per-rule hit masks.
+  - ``rules`` axis: sieve code chunks / advisory shards — the 83-rule
+    scan loop becomes tensor-style parallelism over literal tables, with
+    an ``all_gather`` to rejoin per-rule hit masks.
 """
 
 from .mesh import make_mesh, mesh_axis_sizes
-from .secret_shard import sharded_blockmask, sharded_dfa_hits
+from .secret_shard import sharded_blockmask
 
-__all__ = ["make_mesh", "mesh_axis_sizes", "sharded_blockmask",
-           "sharded_dfa_hits"]
+__all__ = ["make_mesh", "mesh_axis_sizes", "sharded_blockmask"]

@@ -1,13 +1,10 @@
 """Mesh-sharded secret kernels.
 
-Two kernels ride the ``(data, rules)`` mesh with ``shard_map``:
-
-  - the literal blockmask sieve (trivy_tpu.ops.keywords) — segments
-    sharded on ``data``, code tables sharded on ``rules``, per-shard
-    [b, k] masks rejoined by an ``all_gather`` along ``rules`` (the
-    collective rides ICI, not host RAM);
-  - the grouped DFA hit detector (trivy_tpu.ops.dfa) — same layout
-    over rule-group automata.
+The literal blockmask sieve (trivy_tpu.ops.keywords) rides the
+``(data, rules)`` mesh with ``shard_map``: segments sharded on
+``data``, code tables sharded on ``rules``, per-shard [b, k] masks
+rejoined by an ``all_gather`` along ``rules`` (the collective rides
+ICI, not host RAM).
 
 This is the TPU mapping of the reference's per-file × per-rule nested
 goroutine loops (pkg/fanal/secret/scanner.go:341 + analyzer fan-out,
@@ -20,62 +17,8 @@ import functools
 
 import numpy as np
 
-from ..ops.dfa import dfa_hits_impl
 from ..ops.keywords import CODE_CHUNK, code_blockmask_impl
 from .mesh import DATA_AXIS, RULES_AXIS, mesh_axis_sizes, pad_to_multiple
-
-
-@functools.lru_cache(maxsize=8)
-def _build_dfa(mesh, L: int):
-    import jax
-    from jax.sharding import PartitionSpec as P
-
-    def local(segments, class_maps, trans, accept):
-        hits = dfa_hits_impl(segments, class_maps, trans, accept)
-        return jax.lax.all_gather(hits, RULES_AXIS, axis=1, tiled=True)
-
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(RULES_AXIS, None),
-                  P(RULES_AXIS, None, None), P(RULES_AXIS, None)),
-        out_specs=P(DATA_AXIS, None),
-        # the scan carry is created inside the body (vma-free) and mixed
-        # with sharded operands; skip the varying-axes type check.
-        check_vma=False,
-    )
-    return jax.jit(fn)
-
-
-def sharded_dfa_hits(mesh, segments: np.ndarray, class_maps, trans,
-                     accept) -> np.ndarray:
-    """[B, L] uint8 segments → [B, G] uint32 hit masks, over ``mesh``.
-
-    Pads B up to the data-axis size and G up to the rules-axis size;
-    pad rows/groups are all-zero (state-0 self-loop, accept 0) so they
-    contribute nothing. Returns the unpadded [B, G] array.
-    """
-    d, r = mesh_axis_sizes(mesh)
-    B, L = segments.shape
-    G = class_maps.shape[0]
-    Bp = pad_to_multiple(B, d)
-    Gp = pad_to_multiple(G, r)
-
-    if Bp != B:
-        segments = np.concatenate(
-            [segments, np.zeros((Bp - B, L), segments.dtype)])
-    if Gp != G:
-        S, C = trans.shape[1], trans.shape[2]
-        class_maps = np.concatenate(
-            [class_maps, np.zeros((Gp - G, 256), class_maps.dtype)])
-        trans = np.concatenate(
-            [trans, np.zeros((Gp - G, S, C), trans.dtype)])
-        accept = np.concatenate(
-            [accept, np.zeros((Gp - G, S), accept.dtype)])
-
-    fn = _build_dfa(mesh, L)
-    hits = np.asarray(fn(segments, class_maps, trans, accept))
-    return hits[:B, :G]
 
 
 @functools.lru_cache(maxsize=8)

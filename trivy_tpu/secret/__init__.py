@@ -1,8 +1,9 @@
 """Secret detection engine.
 
 CPU path: exact reference semantics (pkg/fanal/secret/scanner.go).
-TPU path: DFA hit-detection kernel (trivy_tpu.ops.dfa) + sparse host
-verification, orchestrated by trivy_tpu.secret.batch.
+TPU path: literal/anchor blockmask sieve (trivy_tpu.ops.keywords) +
+class-run gates (trivy_tpu.ops.runs) + sparse host verification,
+orchestrated by trivy_tpu.secret.batch.
 """
 
 from .model import (

@@ -8,12 +8,17 @@ pkg/fanal/secret/scanner.go:341):
   2. ONE kernel dispatch matches every gate keyword + anchor literal
      over every segment (trivy_tpu.ops.keywords), returning per-segment
      position bitmasks — pure elementwise work, no gathers;
-  3. host decodes hits: a rule is *gated in* for a file iff one of its
+  3. a second elementwise kernel over the same buffer detects mandatory
+     class-runs (trivy_tpu.ops.runs) — rules the window proof rejects
+     but whose regex provably requires, say, 40 consecutive base64
+     bytes (aws-secret-access-key) are gated out of the whole-file host
+     scan when no such run exists anywhere in the file;
+  4. host decodes hits: a rule is *gated in* for a file iff one of its
      keywords hit (reference MatchKeywords semantics); for rules whose
      regex is provably anchor-bounded (rx.anchor), a preliminary regex
      over small windows around anchor hits decides whether the rule can
      match at all;
-  4. files with surviving rules get a CPU-exact scan restricted to
+  5. files with surviving rules get a CPU-exact scan restricted to
      those rules — byte-identical findings, because every rule that
      could contribute findings (or censoring) survives the sieve.
 """
@@ -56,11 +61,14 @@ class BatchSecretScanner:
         self.scanner = scanner
         self.backend = backend
         self.mesh = mesh
-        self.overlap = max(OVERLAP, MAX_CODE_LEN)
+        self.plan: ScanPlan = build_scan_plan(self.scanner.rules)
+        # overlap ≥ max run length so a straddling class-run appears
+        # whole in at least one segment (ops/runs.py soundness)
+        self.overlap = max(OVERLAP, MAX_CODE_LEN,
+                           self.plan.max_runlen)
         # kernels need L % 128 == 0 (lane width / block reduction)
         self.seg_len = max(seg_len, 4 * self.overlap, 128)
         self.seg_len = ((self.seg_len + 127) // 128) * 128
-        self.plan: ScanPlan = build_scan_plan(self.scanner.rules)
 
     # --- segmenting ---
 
@@ -129,6 +137,17 @@ class BatchSecretScanner:
         masks = run_blockmask(buf, self.plan.table,
                               backend=self.backend, mesh=self.mesh)
 
+        # run-hits dispatch is lazy: it fires at most once per batch,
+        # and only when a run-gated rule survives its keyword gate
+        runs_cache: dict = {}
+        runs_ready = [False]
+
+        def file_runs(fidx) -> set:
+            if not runs_ready[0]:
+                runs_cache.update(self._file_runs(buf, seg_file))
+                runs_ready[0] = True
+            return runs_cache.get(fidx, set())
+
         # per file: code → merged list of (segment file-offset, bitmask)
         file_codes: dict = {}
         seg_nz, code_nz = np.nonzero(masks)
@@ -140,13 +159,21 @@ class BatchSecretScanner:
         blk = self.seg_len // N_BLOCKS
         out: dict = {}
 
+        def runs_pass(rp, fidx) -> bool:
+            return not rp.run_gate or \
+                set(rp.run_gate) <= file_runs(fidx)
+
         # rules with no keyword gate and no anchor run everywhere
-        # (reference: empty keyword list passes MatchKeywords)
-        always = [rp.rule_index for rp in self.plan.rules
+        # (reference: empty keyword list passes MatchKeywords),
+        # unless a mandatory class-run is provably absent
+        always = [rp for rp in self.plan.rules
                   if not rp.gate and not rp.anchored]
         if always:
             for fe in entries:
-                out[fe.index] = set(always)
+                sel = {rp.rule_index for rp in always
+                       if runs_pass(rp, fe.index)}
+                if sel:
+                    out[fe.index] = sel
 
         for fidx, codes in file_codes.items():
             fe = by_index[fidx]
@@ -156,7 +183,8 @@ class BatchSecretScanner:
                 if rp.gate and not (hit & rp.gate):
                     continue
                 if not rp.anchored:
-                    chosen.add(rp.rule_index)
+                    if runs_pass(rp, fidx):
+                        chosen.add(rp.rule_index)
                     continue
                 anchor_hits = [h for a in rp.anchors
                                for h in codes.get(a, ())]
@@ -166,6 +194,26 @@ class BatchSecretScanner:
                     chosen.add(rp.rule_index)
             if chosen:
                 out[fidx] = chosen
+        return out
+
+    def _file_runs(self, buf: np.ndarray, seg_file: list) -> dict:
+        """file index → set of run-spec indices present somewhere in
+        the file. One elementwise dispatch over the same segment
+        buffer the sieve used; overlap ≥ max runlen keeps it sound."""
+        specs = tuple(self.plan.run_specs)
+        if not specs:
+            return {}
+        from ..ops.runs import make_run_hits, run_hits_host
+        if self.backend == "cpu-ref":
+            hits = run_hits_host(buf, specs)
+        else:
+            from ..ops.keywords import pad_batch
+            B = buf.shape[0]
+            hits = np.asarray(
+                make_run_hits(specs)(pad_batch(buf)))[:B]
+        out: dict = {}
+        for si, sp in zip(*np.nonzero(hits)):
+            out.setdefault(seg_file[int(si)], set()).add(int(sp))
         return out
 
     def _prelim(self, fe: _FileEntry, rp, anchor_hits: list,

@@ -1,23 +1,19 @@
-"""Regex compilation pipeline for the TPU secret kernel.
+"""Regex analysis pipeline for the TPU secret sieve.
 
-parse → (over-approximate) AST → Thompson NFA → subset-construction DFA
-with byte-class compression → packed int32 tables consumed by
-``trivy_tpu.ops.dfa``.
+parse → AST (over-approximating the RE2 subset the builtin rules use)
+→ anchor/window/run-gate analysis (``rx.anchor``) consumed by
+``trivy_tpu.secret.plan`` to build the literal sieve + class-run gates.
 
-The compiled automaton is a *hit detector*: it recognizes ``.*R'`` where
-R' is a superset language of the rule regex R (anchors and word
-boundaries relaxed, huge counted repeats widened). False positives are
-discarded by host-side exact re-matching; false negatives are impossible
-by construction — the parity property the whole TPU path rests on.
+The sieve is a *hit detector*: it can only over-approximate the rule
+language. False positives are discarded by host-side exact
+re-matching; false negatives are impossible by construction — the
+parity property the whole TPU path rests on.
 """
 
 from .parser import parse, RegexParseError
-from .nfa import NFA, build_nfa
-from .dfa import DFA, build_dfa, DFAOverflow
-from .pack import RulePack, compile_rules, load_or_compile, rule_window
+from .anchor import RuleAnchor, analyze_rule, run_gates, strip_elastic
 
 __all__ = [
-    "parse", "RegexParseError", "NFA", "build_nfa", "DFA", "build_dfa",
-    "DFAOverflow", "RulePack", "compile_rules", "load_or_compile",
-    "rule_window",
+    "parse", "RegexParseError", "RuleAnchor", "analyze_rule",
+    "run_gates", "strip_elastic",
 ]

@@ -331,8 +331,13 @@ def run_gates(node) -> list:
     out = []
     if isinstance(node, Rep):
         if node.min >= 1:
-            if isinstance(node.node, Lit) and \
-                    node.min >= MIN_RUN_GATE:
+            # Unicode-aware classes (\d \w \s: ascii_only=False) match
+            # multibyte codepoints the ASCII byteset can't see — a
+            # byte-run gate built from them would create false
+            # negatives (e.g. 16 Arabic-Indic digits match \d{16} with
+            # zero ASCII-digit bytes). Only ASCII-exact units gate.
+            if isinstance(node.node, Lit) and node.node.ascii_only \
+                    and node.min >= MIN_RUN_GATE:
                 out.append((node.node.bytes,
                             min(node.min, MAX_RUN_GATE)))
             else:

@@ -6,10 +6,10 @@ Supported syntax (the RE2/Python common subset the builtin rules use):
   alternation, quantifiers ``* + ? {m} {m,} {m,n}`` (incl. lazy forms),
   global ``(?i)``/``(?s)`` prefix flags and scoped ``(?i:...)`` groups.
 
-The AST is built directly over byte sets so case folding and DFA
+The AST is built directly over byte sets so case folding and sieve
 construction are trivial downstream. Anchors/word-boundaries parse into
-``Boundary`` nodes; the NFA builder relaxes them to ε (over-approximation
-— see package docstring).
+``Boundary`` nodes; the anchor analysis treats them as ε
+(over-approximation — see package docstring).
 """
 
 from __future__ import annotations
@@ -65,7 +65,7 @@ class Rep:
 
 @dataclass
 class Boundary:
-    """Zero-width assertion: ^ $ \\b \\B — relaxed to ε in the NFA."""
+    """Zero-width assertion: ^ $ \\b \\B — treated as ε downstream."""
     kind: str
 
 
@@ -225,8 +225,8 @@ class _Parser:
     def _lit(self, b: int, flags: _Flags) -> Lit:
         if b >= 0x80:
             # a non-ASCII literal char is 1 unit but 2-4 bytes in the
-            # str regex; modelling it as one byte corrupts both the
-            # DFA and window math — reject, the rule host-falls-back
+            # str regex; modelling it as one byte corrupts the window
+            # math — reject, the rule host-falls-back
             raise RegexParseError(
                 f"non-ASCII literal U+{b:04X} in {self.p!r}")
         bs = frozenset([b])
