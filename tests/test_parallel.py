@@ -50,3 +50,51 @@ def test_batch_scanner_over_mesh():
     r2 = [s for _, s in meshy.scan_files(files)]
     assert [s.to_dict() for s in r1] == [s.to_dict() for s in r2]
     assert {s.file_path for s in r1} == {"a/config.py", "c/token.env"}
+
+
+def test_sharded_interval_hits_matches_host():
+    from trivy_tpu.ops.intervals import (MAX_INTERVALS, NEG_INF,
+                                         POS_INF, interval_hits_host)
+    from trivy_tpu.parallel import make_mesh, sharded_interval_hits
+
+    rng = np.random.default_rng(7)
+    P = 37                      # deliberately not a device multiple
+    pkg_rank = rng.integers(0, 200, P).astype(np.int32)
+    v_lo = rng.integers(0, 200, (P, MAX_INTERVALS)).astype(np.int32)
+    v_hi = v_lo + rng.integers(0, 60, (P, MAX_INTERVALS)).astype(np.int32)
+    s_lo = np.full((P, MAX_INTERVALS), POS_INF, np.int32)
+    s_hi = np.full((P, MAX_INTERVALS), NEG_INF, np.int32)
+    s_lo[::3] = v_lo[::3] + 5
+    s_hi[::3] = v_hi[::3] + 5
+    flags = rng.integers(0, 8, P).astype(np.int32)
+    mesh = make_mesh(8)
+    got = sharded_interval_hits(mesh, pkg_rank, v_lo, v_hi, s_lo,
+                                s_hi, flags)
+    want = interval_hits_host(pkg_rank, v_lo, v_hi, s_lo, s_hi, flags)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batch_runner_mesh_equals_single_device(tmp_path):
+    """Full pipeline, 8-device mesh vs single device: identical
+    reports (VERDICT r2 #3 — sieve + intervals + assembly)."""
+    from trivy_tpu.db.compiled import CompiledDB
+    from trivy_tpu.parallel import make_mesh
+    from trivy_tpu.runtime import BatchScanRunner
+    from trivy_tpu.utils.synth import tiny_fleet
+
+    paths, store = tiny_fleet(str(tmp_path), n_images=6)
+    cdb = CompiledDB.compile(store)
+
+    def run(mesh):
+        rs = BatchScanRunner(store=cdb, mesh=mesh).scan_paths(paths)
+        assert all(r.error == "" for r in rs)
+        return [r.report.to_dict() for r in rs]
+
+    single = run(None)
+    meshed = run(make_mesh(8))
+    assert single == meshed
+    n_vulns = sum(len(res.get("Vulnerabilities") or [])
+                  for rep in meshed for res in rep.get("Results") or [])
+    n_secrets = sum(len(res.get("Secrets") or [])
+                    for rep in meshed for res in rep.get("Results") or [])
+    assert n_vulns and n_secrets

@@ -340,16 +340,21 @@ class CompiledDB:
 
     # ---- device residency ----
 
-    def device_tables(self):
-        """Push tables to the default device once; reuse across
-        scans. Returns (v_lo, v_hi, s_lo, s_hi, flags) device arrays."""
+    def device_tables(self, mesh=None):
+        """Push tables to the default device (or replicated across a
+        mesh) once; reuse across scans. Returns (v_lo, v_hi, s_lo,
+        s_hi, flags) device arrays."""
         import jax
-        key = "default"
+        key = "default" if mesh is None else mesh
         if key not in self._device:
-            self._device[key] = tuple(
-                jax.device_put(a) for a in
-                (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
-                 self.flags))
+            arrs = (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
+                    self.flags)
+            if mesh is None:
+                placed = tuple(jax.device_put(a) for a in arrs)
+            else:
+                from ..parallel.interval_shard import replicate_tables
+                placed = replicate_tables(mesh, arrs)
+            self._device[key] = placed
         return self._device[key]
 
     # ---- enrichment reads (db.Config parity) ----

@@ -74,8 +74,11 @@ class _RankSpace:
         return lo, hi
 
 
-def detect_pairs(jobs: list, backend: str = "tpu") -> list:
-    """Returns payloads of vulnerable pairs, batch order preserved."""
+def detect_pairs(jobs: list, backend: str = "tpu",
+                 mesh=None) -> list:
+    """Returns payloads of vulnerable pairs, batch order preserved.
+    With ``mesh``, pair rows shard over every chip (see
+    parallel.interval_shard)."""
     if not jobs:
         return []
     spaces: dict = {}
@@ -121,10 +124,16 @@ def detect_pairs(jobs: list, backend: str = "tpu") -> list:
             for j, iv in enumerate(sec_ivs):
                 s_lo[i, j], s_hi[i, j] = sp.encode(iv)
             flags_arr[i] = flags
-        fn = interval_hits_host if backend == "cpu-ref" else \
-            _device_hits
-        hits = np.asarray(fn(pkg_rank, v_lo, v_hi, s_lo, s_hi,
-                             flags_arr))
+        if backend == "cpu-ref":
+            hits = np.asarray(interval_hits_host(
+                pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
+        elif mesh is not None:
+            from ..parallel.interval_shard import sharded_interval_hits
+            hits = sharded_interval_hits(
+                mesh, pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr)
+        else:
+            hits = np.asarray(_device_hits(
+                pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
         out.extend(rows[i][0].payload for i in np.nonzero(hits)[0])
 
     # host fallback pairs: exact per-pair evaluation
@@ -229,7 +238,8 @@ class ResidentPairJob:
     payload: object = None
 
 
-def detect_pairs_resident(jobs: list, backend: str = "tpu") -> list:
+def detect_pairs_resident(jobs: list, backend: str = "tpu",
+                          mesh=None) -> list:
     """Evaluate ResidentPairJobs in one gather-dispatch against the
     resident tables. Host work is O(jobs): rank lookups are cached
     per (grammar, version); the advisory universe is never touched."""
@@ -268,6 +278,12 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu") -> list:
                 pkg_rank, cdb.v_lo[row_idx], cdb.v_hi[row_idx],
                 cdb.s_lo[row_idx], cdb.s_hi[row_idx],
                 cdb.flags[row_idx])
+        elif mesh is not None:
+            from ..parallel.interval_shard import \
+                sharded_interval_hits_resident
+            tables = cdb.device_tables(mesh=mesh)
+            hits = sharded_interval_hits_resident(
+                mesh, pkg_rank, row_idx, tables)
         else:
             import jax.numpy as jnp
             from ..ops.intervals import interval_hits_resident
@@ -282,15 +298,18 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu") -> list:
     return out
 
 
-def dispatch_jobs(jobs: list, backend: str = "tpu") -> list:
+def dispatch_jobs(jobs: list, backend: str = "tpu",
+                  mesh=None) -> list:
     """Mixed-job dispatcher: classic PairJobs (per-dispatch compile)
     and ResidentPairJobs (compiled store), each in one kernel call."""
     plain = [j for j in jobs if isinstance(j, PairJob)]
     resident = [j for j in jobs if isinstance(j, ResidentPairJob)]
-    out = detect_pairs(plain, backend=backend) if plain else []
+    out = detect_pairs(plain, backend=backend, mesh=mesh) \
+        if plain else []
     by_db: dict = {}
     for j in resident:
         by_db.setdefault(id(j.cdb), []).append(j)
     for js in by_db.values():
-        out.extend(detect_pairs_resident(js, backend=backend))
+        out.extend(detect_pairs_resident(js, backend=backend,
+                                         mesh=mesh))
     return out

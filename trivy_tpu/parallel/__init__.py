@@ -12,7 +12,10 @@ those axes onto a `jax.sharding.Mesh`:
     an ``all_gather`` to rejoin per-rule hit masks.
 """
 
+from .interval_shard import (sharded_interval_hits,
+                             sharded_interval_hits_resident)
 from .mesh import make_mesh, mesh_axis_sizes
 from .secret_shard import sharded_blockmask
 
-__all__ = ["make_mesh", "mesh_axis_sizes", "sharded_blockmask"]
+__all__ = ["make_mesh", "mesh_axis_sizes", "sharded_blockmask",
+           "sharded_interval_hits", "sharded_interval_hits_resident"]

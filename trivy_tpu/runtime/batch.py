@@ -111,7 +111,8 @@ class BatchScanRunner:
                 all_jobs.append(job)
         detected_by_image: dict = {}
         for idx, payload in dispatch_jobs(all_jobs,
-                                          backend=options.backend):
+                                          backend=options.backend,
+                                          mesh=self.mesh):
             detected_by_image.setdefault(idx, []).append(payload)
 
         # ---- phase 5: assemble per image ----
