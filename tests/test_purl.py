@@ -1,0 +1,189 @@
+"""purl conversion parity tests (mirrors pkg/purl/purl_test.go)."""
+
+import pytest
+
+from trivy_tpu import purl
+from trivy_tpu.types.artifact import OS, Package
+
+
+def test_maven_package():
+    p = purl.new_package_url(
+        "jar", Package(name="org.springframework:spring-core",
+                       version="5.3.14"))
+    assert (p.type, p.namespace, p.name, p.version) == \
+        ("maven", "org.springframework", "spring-core", "5.3.14")
+    assert p.to_string() == \
+        "pkg:maven/org.springframework/spring-core@5.3.14"
+
+
+def test_gradle_keeps_own_type():
+    p = purl.new_package_url(
+        "gradle", Package(name="org.springframework:spring-core",
+                          version="5.3.14"))
+    assert (p.type, p.namespace, p.name) == \
+        ("gradle", "org.springframework", "spring-core")
+
+
+def test_npm_scoped():
+    p = purl.new_package_url(
+        "yarn", Package(name="@xtuc/ieee754", version="1.2.0"))
+    assert (p.type, p.namespace, p.name) == ("npm", "@xtuc", "ieee754")
+    assert p.to_string() == "pkg:npm/%40xtuc/ieee754@1.2.0"
+
+
+def test_npm_plain():
+    p = purl.new_package_url(
+        "pnpm", Package(name="lodash", version="4.17.21"))
+    assert (p.type, p.namespace, p.name) == ("npm", "", "lodash")
+    assert p.to_string() == "pkg:npm/lodash@4.17.21"
+
+
+def test_pypi_normalized():
+    p = purl.new_package_url(
+        "pip", Package(name="Django_test", version="1.2.0"))
+    assert (p.type, p.name) == ("pypi", "django-test")
+
+
+def test_composer():
+    p = purl.new_package_url(
+        "composer", Package(name="symfony/contracts", version="v1.0.2"))
+    assert (p.type, p.namespace, p.name) == \
+        ("composer", "symfony", "contracts")
+
+
+def test_golang_lowercased():
+    p = purl.new_package_url(
+        "gomod", Package(name="github.com/go-sql-driver/Mysql",
+                         version="v1.5.0"))
+    assert (p.namespace, p.name) == ("github.com/go-sql-driver", "mysql")
+
+
+def test_os_package_rpm():
+    p = purl.new_package_url(
+        "redhat",
+        Package(name="acl", version="2.2.53", release="1.el8",
+                arch="aarch64"),
+        os=OS(family="redhat", name="8"))
+    assert (p.type, p.namespace, p.name, p.version) == \
+        ("rpm", "redhat", "acl", "2.2.53-1.el8")
+    assert dict(p.qualifiers) == \
+        {"arch": "aarch64", "distro": "redhat-8"}
+    assert p.to_string() == ("pkg:rpm/redhat/acl@2.2.53-1.el8"
+                             "?arch=aarch64&distro=redhat-8")
+
+
+def test_os_package_apk_distro_is_version():
+    p = purl.new_package_url(
+        "alpine",
+        Package(name="alpine-baselayout", version="3.2.0-r16"),
+        os=OS(family="alpine", name="3.14.2"))
+    assert p.to_string() == ("pkg:apk/alpine/alpine-baselayout@3.2.0-r16"
+                             "?distro=3.14.2")
+
+
+def test_deb_distro_qualifier():
+    p = purl.new_package_url(
+        "debian", Package(name="libc6", version="2.31-13"),
+        os=OS(family="debian", name="11"))
+    assert p.to_string() == \
+        "pkg:deb/debian/libc6@2.31-13?distro=debian-11"
+
+
+def test_rpm_epoch_and_modularity():
+    p = purl.new_package_url(
+        "centos",
+        Package(name="dbus", version="1.12.8", release="14.el8",
+                epoch=1, modularity_label="m:1"),
+        os=OS(family="centos", name="8.3"))
+    assert p.version == "1:1.12.8-14.el8"
+    assert ("modularitylabel", "m:1") in p.qualifiers
+
+
+def test_oci_purl():
+    p = purl.oci_package_url(
+        ["cblmariner2preview.azurecr.io/base/core@sha256:8fe1727132b2506"
+         "c17ba0e1f6a6ed8a016bb1f5735e43b2738cd3fd1979b6260"],
+        architecture="amd64")
+    assert (p.type, p.name) == ("oci", "core")
+    assert p.version.startswith("sha256:8fe17")
+    assert p.qualifier("repository_url") == \
+        "cblmariner2preview.azurecr.io/base/core"
+
+
+def test_oci_implicit_registry_and_tag():
+    p = purl.oci_package_url(
+        ["alpine:3.14@sha256:8fe1727132b2506c17ba0e1f6a6ed8a016bb1f5735e"
+         "43b2738cd3fd1979b6260"], architecture="amd64")
+    assert p.name == "alpine"
+    assert p.qualifier("repository_url") == "index.docker.io/library/alpine"
+
+
+def test_oci_bad_digest():
+    with pytest.raises(ValueError):
+        purl.oci_package_url(["sha256:8fe1727132b2506c17ba0e1f6a6ed8a0"])
+
+
+def test_oci_empty():
+    assert purl.oci_package_url([]).type == ""
+
+
+def test_from_string_maven():
+    p = purl.from_string(
+        "pkg:maven/org.springframework/spring-core@5.0.4.RELEASE")
+    assert (p.type, p.namespace, p.name, p.version) == \
+        ("maven", "org.springframework", "spring-core", "5.0.4.RELEASE")
+
+
+def test_from_string_qualifier_decode():
+    p = purl.from_string(
+        "pkg:npm/bootstrap@5.0.2?file_path=app%2Fapp%2Fpackage.json")
+    assert p.qualifier("file_path") == "app/app/package.json"
+
+
+def test_from_string_scoped_npm():
+    p = purl.from_string("pkg:npm/%40xtuc/ieee754@1.2.0")
+    assert (p.namespace, p.name, p.version) == \
+        ("@xtuc", "ieee754", "1.2.0")
+
+
+def test_from_string_no_name_raises():
+    with pytest.raises(ValueError):
+        purl.from_string("pkg:maven/")
+    with pytest.raises(ValueError):
+        purl.from_string("maven/a@1")
+
+
+def test_package_back_conversion_maven():
+    p = purl.from_string("pkg:maven/org.springframework/spring-core@5.3")
+    pkg = p.package()
+    assert pkg.name == "org.springframework:spring-core"
+    assert p.app_type() == "jar"
+
+
+def test_package_back_conversion_rpm():
+    p = purl.from_string(
+        "pkg:rpm/redhat/dbus@1:1.12.8-14.el8?arch=x86_64")
+    pkg = p.package()
+    assert (pkg.name, pkg.epoch, pkg.version, pkg.release, pkg.arch) == \
+        ("dbus", 1, "1.12.8", "14.el8", "x86_64")
+    assert p.is_os_pkg()
+
+
+def test_bom_ref_file_path_uniqueness():
+    p = purl.new_package_url(
+        "npm", Package(name="bootstrap", version="5.0.2",
+                       file_path="app/app/package.json"))
+    assert p.to_string() == "pkg:npm/bootstrap@5.0.2"
+    assert p.bom_ref() == \
+        "pkg:npm/bootstrap@5.0.2?file_path=app%2Fapp%2Fpackage.json"
+
+
+def test_roundtrip():
+    for s in [
+        "pkg:maven/org.springframework/spring-core@5.0.4.RELEASE",
+        "pkg:npm/%40xtuc/ieee754@1.2.0",
+        "pkg:apk/alpine/alpine-baselayout@3.2.0-r16?distro=3.14.2",
+        "pkg:rpm/redhat/containers-common@0.1.14",
+        "pkg:golang/github.com/go-sql-driver/mysql@v1.5.0",
+    ]:
+        assert purl.from_string(s).to_string() == s

@@ -1,0 +1,292 @@
+"""Report writer tests: sarif / cyclonedx / spdx / github /
+cosign-vuln / template (mirrors pkg/report/sarif_test.go,
+pkg/sbom/cyclonedx/marshal_test.go shapes)."""
+
+import io
+import json
+
+import pytest
+
+from trivy_tpu.report import write_report
+from trivy_tpu.types import (DataSource, DetectedVulnerability,
+                             Metadata, Report, Result, Vulnerability)
+from trivy_tpu.types.artifact import OS, Package
+from trivy_tpu.types import SecretFinding
+from trivy_tpu.types.report import ResultClass
+
+
+def _report() -> Report:
+    vuln = DetectedVulnerability(
+        vulnerability_id="CVE-2019-14697",
+        pkg_name="musl",
+        installed_version="1.1.20-r4",
+        fixed_version="1.1.20-r5",
+        severity_source="nvd",
+        primary_url="https://avd.aquasec.com/nvd/cve-2019-14697",
+        data_source=DataSource(id="alpine", name="Alpine SecDB",
+                               url="https://secdb.alpinelinux.org/"),
+        vulnerability=Vulnerability(
+            title="musl x87 stack imbalance",
+            description="x87 floating-point stack adjustment bug",
+            severity="CRITICAL",
+            vendor_severity={"nvd": "CRITICAL"},
+            cvss={"nvd": {"V3Score": 9.8,
+                          "V3Vector": "CVSS:3.1/AV:N/AC:L"}},
+            references=["https://example.com/ref"],
+            cwe_ids=["CWE-787"],
+        ),
+    )
+    secret = SecretFinding(
+        rule_id="aws-access-key-id", category="AWS",
+        severity="CRITICAL", title="AWS Access Key ID",
+        start_line=3, end_line=3, match="AKIA****************")
+    return Report(
+        artifact_name="test/alpine:3.9",
+        artifact_type="container_image",
+        metadata=Metadata(
+            os=OS(family="alpine", name="3.9.4"),
+            image_id="sha256:abcd",
+            repo_tags=["test/alpine:3.9"],
+            repo_digests=["test/alpine@sha256:" + "ab" * 32],
+            image_config={"architecture": "amd64"},
+        ),
+        results=[
+            Result(target="test/alpine:3.9 (alpine 3.9.4)",
+                   class_=ResultClass.OSPKG, type="alpine",
+                   packages=[Package(name="musl", version="1.1.20",
+                                     release="r4", arch="x86_64",
+                                     src_name="musl",
+                                     src_version="1.1.20",
+                                     src_release="r4",
+                                     licenses=["MIT"])],
+                   vulnerabilities=[vuln]),
+            Result(target="app/config.env",
+                   class_=ResultClass.SECRET, type="secret",
+                   secrets=[secret]),
+        ])
+
+
+def _write(fmt, report=None, **kw) -> str:
+    buf = io.StringIO()
+    write_report(report or _report(), fmt=fmt, output=buf, **kw)
+    return buf.getvalue()
+
+
+class TestSarif:
+    def test_structure(self):
+        doc = json.loads(_write("sarif"))
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "Trivy"
+        rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+        assert set(rules) == {"CVE-2019-14697", "aws-access-key-id"}
+        vuln_rule = rules["CVE-2019-14697"]
+        assert vuln_rule["name"] == "OsPackageVulnerability"
+        assert vuln_rule["defaultConfiguration"]["level"] == "error"
+        assert vuln_rule["properties"]["security-severity"] == "9.8"
+        assert rules["aws-access-key-id"]["name"] == "Secret"
+
+    def test_results_and_regions(self):
+        run = json.loads(_write("sarif"))["runs"][0]
+        by_rule = {r["ruleId"]: r for r in run["results"]}
+        vuln_loc = by_rule["CVE-2019-14697"]["locations"][0]
+        assert vuln_loc["physicalLocation"]["artifactLocation"][
+            "uri"] == "test/alpine"
+        secret_loc = by_rule["aws-access-key-id"]["locations"][0]
+        assert secret_loc["physicalLocation"]["region"][
+            "startLine"] == 3
+        assert run["originalUriBaseIds"]["ROOTPATH"]["uri"] == \
+            "file:///"
+
+    def test_rule_dedup_keeps_index(self):
+        report = _report()
+        report.results[0].vulnerabilities.append(
+            report.results[0].vulnerabilities[0])
+        run = json.loads(_write("sarif", report))["runs"][0]
+        assert len(run["tool"]["driver"]["rules"]) == 2
+        idxs = [r["ruleIndex"] for r in run["results"]
+                if r["ruleId"] == "CVE-2019-14697"]
+        assert idxs == [0, 0]
+
+
+class TestCycloneDX:
+    def test_structure(self):
+        doc = json.loads(_write("cyclonedx"))
+        assert doc["bomFormat"] == "CycloneDX"
+        assert doc["serialNumber"].startswith("urn:uuid:")
+        comp = doc["metadata"]["component"]
+        assert comp["type"] == "container"
+        assert comp["purl"].startswith("pkg:oci/alpine@sha256")
+        types = {c["type"] for c in doc["components"]}
+        assert types == {"library", "operating-system"}
+        lib = [c for c in doc["components"]
+               if c["type"] == "library"][0]
+        assert lib["purl"] == ("pkg:apk/alpine/musl@1.1.20-r4"
+                               "?arch=x86_64&distro=3.9.4")
+        assert lib["licenses"] == [{"expression": "MIT"}]
+
+    def test_dependencies_and_vulns(self):
+        doc = json.loads(_write("cyclonedx"))
+        os_comp = [c for c in doc["components"]
+                   if c["type"] == "operating-system"][0]
+        deps = {d["ref"]: d["dependsOn"] for d in doc["dependencies"]}
+        lib_ref = [c["bom-ref"] for c in doc["components"]
+                   if c["type"] == "library"][0]
+        assert deps[os_comp["bom-ref"]] == [lib_ref]
+        vuln = doc["vulnerabilities"][0]
+        assert vuln["id"] == "CVE-2019-14697"
+        assert vuln["affects"][0]["ref"] == lib_ref
+        assert vuln["cwes"] == [787]
+        rating = [r for r in vuln["ratings"]
+                  if r.get("method") == "CVSSv31"][0]
+        assert rating["score"] == 9.8
+
+    def test_sbom_rescan_exports_vuln_refs_only(self):
+        report = _report()
+        report.artifact_type = "cyclonedx"
+        report.cyclonedx = {
+            "serialNumber": "urn:uuid:abc", "version": 1,
+            "metadata": {"component": {"name": "orig",
+                                       "version": "1",
+                                       "type": "container"}}}
+        report.results[0].vulnerabilities[0].ref = \
+            "pkg:apk/alpine/musl@1.1.20-r4"
+        doc = json.loads(_write("cyclonedx", report))
+        assert "components" not in doc
+        assert doc["metadata"]["component"]["bom-ref"] == \
+            "urn:uuid:abc/1"
+        assert doc["vulnerabilities"][0]["affects"][0]["ref"] == \
+            "urn:cdx:abc/1#pkg:apk/alpine/musl@1.1.20-r4"
+
+
+class TestSPDX:
+    def test_json(self):
+        doc = json.loads(_write("spdx-json"))
+        assert doc["SPDXID"] == "SPDXRef-DOCUMENT"
+        assert doc["spdxVersion"] == "SPDX-2.2"
+        names = {p["name"] for p in doc["packages"]}
+        assert {"test/alpine:3.9", "alpine", "musl"} <= names
+        musl = [p for p in doc["packages"] if p["name"] == "musl"][0]
+        assert musl["externalRefs"][0]["referenceLocator"].startswith(
+            "pkg:apk/alpine/musl@1.1.20-r4")
+        assert musl["sourceInfo"] == \
+            "built package from: musl 1.1.20-r4"
+        rels = {(r["spdxElementId"], r["relationshipType"],
+                 r["relatedSpdxElement"])
+                for r in doc["relationships"]}
+        assert any(a == "SPDXRef-DOCUMENT" and t == "DESCRIBE"
+                   for a, t, _ in rels)
+
+    def test_tag_value_parses_back(self):
+        from trivy_tpu import sbom
+        tv = _write("spdx")
+        assert tv.startswith("SPDXVersion: SPDX-2.2")
+        out = sbom.decode(tv.encode(), "spdx-tv")
+        assert out.os.family == "alpine"
+        assert out.packages[0].packages[0].name == "musl"
+
+
+class TestGithub:
+    def test_snapshot(self):
+        doc = json.loads(_write("github"))
+        assert doc["detector"]["name"] == "trivy"
+        manifest = doc["manifests"]["test/alpine:3.9 (alpine 3.9.4)"]
+        assert manifest["name"] == "alpine"
+        pkg = manifest["resolved"]["musl"]
+        assert pkg["package_url"].startswith("pkg:apk/alpine/musl")
+        assert pkg["relationship"] == "direct"
+        assert pkg["scope"] == "runtime"
+
+
+class TestCosignVuln:
+    def test_predicate(self):
+        doc = json.loads(_write("cosign-vuln"))
+        assert doc["scanner"]["uri"].startswith(
+            "pkg:github/aquasecurity/trivy@")
+        assert doc["scanner"]["result"]["ArtifactName"] == \
+            "test/alpine:3.9"
+        assert "scanStartedOn" in doc["metadata"]
+
+
+class TestTemplate:
+    def test_inline(self):
+        out = _write(
+            "template",
+            output_template='{{ range . }}{{ .Target }}:'
+                            '{{ len .Vulnerabilities }};{{ end }}')
+        assert out == ("test/alpine:3.9 (alpine 3.9.4):1;"
+                       "app/config.env:0;")
+
+    def test_nested_range_and_funcs(self):
+        tpl = ('{{ range . }}{{ range .Vulnerabilities }}'
+               '{{ .VulnerabilityID }}|{{ .Severity | toLower }}|'
+               '{{ escapeXML .Title }}\n{{ end }}{{ end }}')
+        out = _write("template", output_template=tpl)
+        assert out == ("CVE-2019-14697|critical|"
+                       "musl x87 stack imbalance\n")
+
+    def test_if_else_and_vars(self):
+        tpl = ('{{ $n := 0 }}{{ range . }}'
+               '{{ if .Vulnerabilities }}V{{ else }}-{{ end }}'
+               '{{ end }}')
+        out = _write("template", output_template=tpl)
+        assert out == "V-"
+
+    def test_junit_like(self, tmp_path):
+        tpl = """{{- range . -}}
+<testsuite name="{{ .Target }}" tests="{{ .Vulnerabilities | len }}">
+{{- range .Vulnerabilities }}
+  <testcase name="{{ .VulnerabilityID }}[{{ .Severity }}]"/>
+{{- end }}
+</testsuite>
+{{ end }}"""
+        p = tmp_path / "junit.tpl"
+        p.write_text(tpl)
+        out = _write("template", output_template=f"@{p}")
+        assert '<testsuite name="test/alpine:3.9 (alpine 3.9.4)" ' \
+            'tests="1">' in out
+        assert '<testcase name="CVE-2019-14697[CRITICAL]"/>' in out
+
+
+class TestTemplateErrors:
+    def test_missing_template_flag(self):
+        with pytest.raises(ValueError, match="requires"):
+            _write("template", output_template="")
+
+    def test_missing_template_file(self):
+        with pytest.raises(ValueError, match="template"):
+            _write("template", output_template="@/nonexistent.tpl")
+
+
+def test_sbom_formats_list_all_packages():
+    """--format cyclonedx/spdx/github must force the full package
+    inventory even without --list-all-pkgs (review finding r1)."""
+    from trivy_tpu.cli import build_parser, _scan_options
+    for fmt in ("cyclonedx", "spdx", "spdx-json", "github"):
+        args = build_parser().parse_args(
+            ["fs", ".", "--format", fmt])
+        assert _scan_options(args).list_all_packages, fmt
+    args = build_parser().parse_args(["fs", ".", "--format", "json"])
+    assert not _scan_options(args).list_all_packages
+
+
+def test_cyclonedx_links_vuln_by_source_version():
+    """OS detectors report InstalledVersion from the source package;
+    the BOM ref lookup must still link (review finding r2)."""
+    report = _report()
+    pkg = report.results[0].packages[0]
+    pkg.version, pkg.release = "1.2-3+b1", ""      # binNMU binary
+    pkg.src_version, pkg.src_release = "1.2-3", ""
+    v = report.results[0].vulnerabilities[0]
+    v.installed_version = "1.2-3"
+    doc = json.loads(_write("cyclonedx", report))
+    ref = doc["vulnerabilities"][0]["affects"][0]["ref"]
+    assert ref.startswith("pkg:apk/alpine/musl@1.2-3+b1")
+
+
+def test_title_missing_fields_dont_crash():
+    report = Report(artifact_name="x", artifact_type="filesystem",
+                    results=[])
+    for fmt in ["sarif", "cyclonedx", "spdx", "spdx-json", "github",
+                "cosign-vuln"]:
+        assert _write(fmt, report)

@@ -1,0 +1,456 @@
+"""SBOM decode + artifact + CLI tests (mirrors
+pkg/sbom/cyclonedx/unmarshal_test.go, pkg/sbom/spdx/unmarshal_test.go,
+integration sbom tests)."""
+
+import base64
+import json
+
+import pytest
+
+from trivy_tpu import sbom
+from trivy_tpu.sbom import cyclonedx as cdx
+from trivy_tpu.sbom import spdx as spdx_mod
+
+CDX_BOM = {
+    "bomFormat": "CycloneDX",
+    "specVersion": "1.4",
+    "serialNumber": "urn:uuid:c986ba94-e37d-49c8-9e30-96daccd0415b",
+    "version": 1,
+    "metadata": {
+        "timestamp": "2022-05-28T10:20:03+00:00",
+        "component": {
+            "bom-ref": "0f585d64-4815-4b72-92c5-97dae191fa4a",
+            "type": "container",
+            "name": "test-image",
+        },
+    },
+    "components": [
+        {
+            "bom-ref": "pkg:apk/alpine/musl@1.1.20-r4?distro=3.9.4",
+            "type": "library",
+            "name": "musl",
+            "version": "1.1.20-r4",
+            "licenses": [{"expression": "MIT"}],
+            "purl": "pkg:apk/alpine/musl@1.1.20-r4?distro=3.9.4",
+            "properties": [
+                {"name": "aquasecurity:trivy:SrcName", "value": "musl"},
+                {"name": "aquasecurity:trivy:SrcVersion",
+                 "value": "1.1.20-r4"},
+                {"name": "aquasecurity:trivy:LayerDiffID",
+                 "value": "sha256:aaaa"},
+            ],
+        },
+        {
+            "bom-ref": "os-ref",
+            "type": "operating-system",
+            "name": "alpine",
+            "version": "3.9.4",
+            "properties": [
+                {"name": "aquasecurity:trivy:Type", "value": "alpine"},
+                {"name": "aquasecurity:trivy:Class",
+                 "value": "os-pkgs"},
+            ],
+        },
+        {
+            "bom-ref": "app-ref",
+            "type": "application",
+            "name": "app/composer.lock",
+            "properties": [
+                {"name": "aquasecurity:trivy:Type",
+                 "value": "composer"},
+                {"name": "aquasecurity:trivy:Class",
+                 "value": "lang-pkgs"},
+            ],
+        },
+        {
+            "bom-ref": "pkg:composer/pear/log@1.13.1",
+            "type": "library",
+            "name": "pear/log",
+            "version": "1.13.1",
+            "purl": "pkg:composer/pear/log@1.13.1",
+        },
+        {
+            # orphan library, not in any dependency graph
+            "bom-ref": "pkg:golang/golang.org/x/crypto@v0.0.1",
+            "type": "library",
+            "name": "golang.org/x/crypto",
+            "version": "v0.0.1",
+            "purl": "pkg:golang/golang.org/x/crypto@v0.0.1",
+        },
+    ],
+    "dependencies": [
+        {"ref": "os-ref",
+         "dependsOn": ["pkg:apk/alpine/musl@1.1.20-r4?distro=3.9.4"]},
+        {"ref": "app-ref", "dependsOn": ["pkg:composer/pear/log@1.13.1"]},
+        {"ref": "0f585d64-4815-4b72-92c5-97dae191fa4a",
+         "dependsOn": ["os-ref", "app-ref"]},
+    ],
+}
+
+
+class TestDetectFormat:
+    def test_cyclonedx_json(self):
+        data = json.dumps(CDX_BOM).encode()
+        assert sbom.detect_format(data) == "cyclonedx-json"
+
+    def test_spdx_json(self):
+        assert sbom.detect_format(
+            json.dumps({"SPDXID": "SPDXRef-DOCUMENT"}).encode()) == \
+            "spdx-json"
+
+    def test_spdx_tv(self):
+        assert sbom.detect_format(b"SPDXVersion: SPDX-2.2\n") == \
+            "spdx-tv"
+
+    def test_cyclonedx_xml(self):
+        xml = (b'<?xml version="1.0"?>\n'
+               b'<bom xmlns="http://cyclonedx.org/schema/bom/1.4" '
+               b'version="1"><components></components></bom>')
+        assert sbom.detect_format(xml) == "cyclonedx-xml"
+
+    def test_attest(self):
+        stmt = {"predicateType": "https://cyclonedx.org/bom",
+                "predicate": {"Data": CDX_BOM}}
+        env = {"payloadType": "application/vnd.in-toto+json",
+               "payload": base64.b64encode(
+                   json.dumps(stmt).encode()).decode()}
+        assert sbom.detect_format(json.dumps(env).encode()) == \
+            "attest-cyclonedx-json"
+
+    def test_unknown(self):
+        assert sbom.detect_format(b"hello world") == "unknown"
+        assert sbom.detect_format(b"{\"a\": 1}") == "unknown"
+
+
+class TestCycloneDXDecode:
+    def test_os(self):
+        out = cdx.unmarshal(CDX_BOM)
+        assert out.os.family == "alpine"
+        assert out.os.name == "3.9.4"
+
+    def test_os_packages(self):
+        out = cdx.unmarshal(CDX_BOM)
+        assert len(out.packages) == 1
+        pkgs = out.packages[0].packages
+        assert [p.name for p in pkgs] == ["musl"]
+        assert pkgs[0].version == "1.1.20-r4"
+        assert pkgs[0].licenses == ["MIT"]
+        assert pkgs[0].src_name == "musl"
+        assert pkgs[0].layer.diff_id == "sha256:aaaa"
+        assert pkgs[0].ref == \
+            "pkg:apk/alpine/musl@1.1.20-r4?distro=3.9.4"
+
+    def test_applications(self):
+        out = cdx.unmarshal(CDX_BOM)
+        by_type = {a.type: a for a in out.applications}
+        assert set(by_type) == {"composer", "gobinary"}
+        comp = by_type["composer"]
+        assert comp.file_path == "app/composer.lock"
+        assert [p.name for p in comp.libraries] == ["pear/log"]
+        # orphan golang lib aggregates under its purl's app type
+        assert [p.name for p in by_type["gobinary"].libraries] == \
+            ["golang.org/x/crypto"]
+
+    def test_orphan_os_purls_become_os_packages(self):
+        """A foreign BOM (no dependency graph, e.g. syft output) with
+        OS purls must feed the ospkg detector, not a bogus 'apk'
+        application (review finding r3)."""
+        doc = {
+            "bomFormat": "CycloneDX", "specVersion": "1.4",
+            "components": [
+                {"bom-ref": "r1", "type": "library", "name": "musl",
+                 "version": "1.1.20-r4",
+                 "purl": "pkg:apk/alpine/musl@1.1.20-r4"},
+                {"bom-ref": "r2", "type": "library", "name": "lodash",
+                 "version": "4.17.20",
+                 "purl": "pkg:npm/lodash@4.17.20"},
+            ],
+        }
+        out = cdx.unmarshal(doc)
+        assert len(out.packages) == 1
+        pkg = out.packages[0].packages[0]
+        assert pkg.name == "musl"
+        assert pkg.src_name == "musl"
+        assert pkg.src_version == "1.1.20-r4"
+        assert [a.type for a in out.applications] == ["node-pkg"]
+
+    def test_keeps_original_header(self):
+        out = cdx.unmarshal(CDX_BOM)
+        assert out.cyclonedx["serialNumber"] == \
+            CDX_BOM["serialNumber"]
+        assert out.cyclonedx["metadata"]["component"]["name"] == \
+            "test-image"
+
+    def test_attest_decode(self):
+        stmt = {"predicateType": "https://cyclonedx.org/bom",
+                "predicate": {"Data": CDX_BOM}}
+        env = {"payloadType": "application/vnd.in-toto+json",
+               "payload": base64.b64encode(
+                   json.dumps(stmt).encode()).decode()}
+        out = sbom.decode(json.dumps(env).encode(),
+                          "attest-cyclonedx-json")
+        assert out.os.family == "alpine"
+
+    def test_xml_decode(self):
+        xml = """<?xml version="1.0"?>
+<bom xmlns="http://cyclonedx.org/schema/bom/1.4" version="1"
+     serialNumber="urn:uuid:1234">
+  <components>
+    <component bom-ref="os-ref" type="operating-system">
+      <name>alpine</name><version>3.9.4</version>
+    </component>
+    <component bom-ref="pkg:apk/alpine/musl@1.1.20-r4" type="library">
+      <name>musl</name><version>1.1.20-r4</version>
+      <purl>pkg:apk/alpine/musl@1.1.20-r4</purl>
+    </component>
+  </components>
+  <dependencies>
+    <dependency ref="os-ref">
+      <dependency ref="pkg:apk/alpine/musl@1.1.20-r4"/>
+    </dependency>
+  </dependencies>
+</bom>"""
+        out = sbom.decode(xml.encode(), "cyclonedx-xml")
+        assert out.os.family == "alpine"
+        assert out.packages[0].packages[0].name == "musl"
+
+
+SPDX_DOC = {
+    "SPDXID": "SPDXRef-DOCUMENT",
+    "spdxVersion": "SPDX-2.2",
+    "name": "test",
+    "packages": [
+        {"name": "alpine", "versionInfo": "3.9.4",
+         "SPDXID": "SPDXRef-OperatingSystem-1"},
+        {"name": "musl", "versionInfo": "1.1.20-r4",
+         "SPDXID": "SPDXRef-Package-1",
+         "licenseDeclared": "MIT",
+         "sourceInfo": "built package from: musl 1.1.20-r4",
+         "attributionTexts": ["LayerDiffID: sha256:aaaa"],
+         "externalRefs": [{
+             "referenceCategory": "PACKAGE-MANAGER",
+             "referenceType": "purl",
+             "referenceLocator":
+                 "pkg:apk/alpine/musl@1.1.20-r4?distro=3.9.4"}]},
+        {"name": "composer", "SPDXID": "SPDXRef-Application-1",
+         "sourceInfo": "app/composer.lock"},
+        {"name": "pear/log", "versionInfo": "1.13.1",
+         "SPDXID": "SPDXRef-Package-2",
+         "externalRefs": [{
+             "referenceCategory": "PACKAGE-MANAGER",
+             "referenceType": "purl",
+             "referenceLocator": "pkg:composer/pear/log@1.13.1"}]},
+        {"name": "root", "SPDXID": "SPDXRef-ContainerImage-1"},
+    ],
+    "relationships": [
+        {"spdxElementId": "SPDXRef-ContainerImage-1",
+         "relationshipType": "CONTAINS",
+         "relatedSpdxElement": "SPDXRef-OperatingSystem-1"},
+        {"spdxElementId": "SPDXRef-OperatingSystem-1",
+         "relationshipType": "CONTAINS",
+         "relatedSpdxElement": "SPDXRef-Package-1"},
+        {"spdxElementId": "SPDXRef-ContainerImage-1",
+         "relationshipType": "CONTAINS",
+         "relatedSpdxElement": "SPDXRef-Application-1"},
+        {"spdxElementId": "SPDXRef-Application-1",
+         "relationshipType": "CONTAINS",
+         "relatedSpdxElement": "SPDXRef-Package-2"},
+    ],
+}
+
+
+class TestSPDXDecode:
+    def test_json(self):
+        out = spdx_mod.unmarshal(SPDX_DOC)
+        assert out.os.family == "alpine"
+        assert out.os.name == "3.9.4"
+        pkgs = out.packages[0].packages
+        assert [p.name for p in pkgs] == ["musl"]
+        assert pkgs[0].src_name == "musl"
+        assert pkgs[0].src_version == "1.1.20-r4"
+        assert pkgs[0].licenses == ["MIT"]
+        assert pkgs[0].layer.diff_id == "sha256:aaaa"
+        apps = out.applications
+        assert len(apps) == 1
+        assert apps[0].type == "composer"
+        assert apps[0].file_path == "app/composer.lock"
+        assert [p.name for p in apps[0].libraries] == ["pear/log"]
+
+    def test_rpm_source_info_epoch(self):
+        doc = {
+            "SPDXID": "SPDXRef-DOCUMENT",
+            "packages": [
+                {"name": "centos", "versionInfo": "8.3",
+                 "SPDXID": "SPDXRef-OperatingSystem-1"},
+                {"name": "dbus", "SPDXID": "SPDXRef-Package-1",
+                 "sourceInfo":
+                     "built package from: dbus 1:1.12.8-14.el8",
+                 "externalRefs": [{
+                     "referenceCategory": "PACKAGE-MANAGER",
+                     "referenceType": "purl",
+                     "referenceLocator":
+                         "pkg:rpm/centos/dbus@1.12.8-14.el8"}]},
+            ],
+            "relationships": [
+                {"spdxElementId": "SPDXRef-DOCUMENT",
+                 "relationshipType": "DESCRIBE",
+                 "relatedSpdxElement": "SPDXRef-OperatingSystem-1"},
+                {"spdxElementId": "SPDXRef-OperatingSystem-1",
+                 "relationshipType": "CONTAINS",
+                 "relatedSpdxElement": "SPDXRef-Package-1"},
+            ],
+        }
+        out = spdx_mod.unmarshal(doc)
+        pkg = out.packages[0].packages[0]
+        assert (pkg.src_name, pkg.src_epoch, pkg.src_version,
+                pkg.src_release) == ("dbus", 1, "1.12.8", "14.el8")
+
+    def test_tag_value_roundtrip(self):
+        from trivy_tpu.types import Metadata, Report, Result
+        from trivy_tpu.types.artifact import OS, Package
+        from trivy_tpu.types.report import ResultClass
+
+        report = Report(
+            artifact_name="test", artifact_type="filesystem",
+            metadata=Metadata(os=OS(family="alpine", name="3.9.4")),
+            results=[Result(
+                target="test", class_=ResultClass.OSPKG,
+                type="alpine",
+                packages=[Package(name="musl", version="1.1.20",
+                                  release="r4", src_name="musl",
+                                  src_version="1.1.20",
+                                  src_release="r4")])])
+        tv = spdx_mod.Marshaler(
+            timestamp="2022-01-01T00:00:00Z",
+            uuid_fn=lambda: "u1").marshal_tv(report)
+        assert sbom.detect_format(tv.encode()) == "spdx-tv"
+        out = sbom.decode(tv.encode(), "spdx-tv")
+        assert out.os.family == "alpine"
+        pkgs = out.packages[0].packages
+        assert [p.name for p in pkgs] == ["musl"]
+        # non-rpm source info keeps the joined version string
+        # (ref unmarshal.go parseSourceInfo)
+        assert pkgs[0].src_version == "1.1.20-r4"
+
+
+FIXTURE_DB = """
+- bucket: alpine 3.9
+  pairs:
+    - bucket: musl
+      pairs:
+        - key: CVE-2019-14697
+          value: {FixedVersion: 1.1.20-r5}
+- bucket: composer::Packagist
+  pairs:
+    - bucket: pear/log
+      pairs:
+        - key: CVE-2099-0001
+          value: {VulnerableVersions: ["<1.14.0"],
+                  PatchedVersions: [">=1.14.0"]}
+- bucket: vulnerability
+  pairs:
+    - key: CVE-2019-14697
+      value:
+        Title: musl x87 stack imbalance
+        Severity: CRITICAL
+    - key: CVE-2099-0001
+      value:
+        Title: pear/log test advisory
+        Severity: HIGH
+"""
+
+
+class TestSBOMScan:
+    @pytest.fixture()
+    def db_fixture(self, tmp_path):
+        p = tmp_path / "db.yaml"
+        p.write_text(FIXTURE_DB)
+        return str(p)
+
+    @pytest.fixture()
+    def bom_file(self, tmp_path):
+        p = tmp_path / "bom.cdx.json"
+        p.write_text(json.dumps(CDX_BOM))
+        return str(p)
+
+    def _run(self, argv):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+
+    def test_cyclonedx_scan_detects_vulns(self, bom_file, db_fixture,
+                                          tmp_path):
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "sbom", bom_file, "--format", "json",
+            "--output", str(out_file), "--db-fixtures", db_fixture,
+            "--backend", "cpu", "--no-cache",
+            "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        assert report["ArtifactType"] == "cyclonedx"
+        results = report["Results"]
+        by_class = {}
+        for r in results:
+            for v in r.get("Vulnerabilities", []):
+                by_class.setdefault(r["Class"], []).append(
+                    v["VulnerabilityID"])
+        assert by_class.get("os-pkgs") == ["CVE-2019-14697"]
+        assert by_class.get("lang-pkgs") == ["CVE-2099-0001"]
+
+    def test_artifact_cache_key_stable(self, bom_file, tmp_path):
+        from trivy_tpu.artifact.cache import MemoryCache
+        from trivy_tpu.artifact.sbom import SBOMArtifact
+        ref1 = SBOMArtifact(bom_file, MemoryCache()).inspect()
+        ref2 = SBOMArtifact(bom_file, MemoryCache()).inspect()
+        assert ref1.id == ref2.id
+        assert ref1.type == "cyclonedx"
+        assert ref1.cyclonedx["serialNumber"] == \
+            CDX_BOM["serialNumber"]
+
+    def test_spdx_scan(self, db_fixture, tmp_path):
+        p = tmp_path / "bom.spdx.json"
+        p.write_text(json.dumps(SPDX_DOC))
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "sbom", str(p), "--format", "json",
+            "--output", str(out_file), "--db-fixtures", db_fixture,
+            "--backend", "cpu", "--no-cache",
+            "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        assert report["ArtifactType"] == "spdx"
+        ids = [v["VulnerabilityID"] for r in report["Results"]
+               for v in r.get("Vulnerabilities", [])]
+        assert "CVE-2019-14697" in ids
+        assert "CVE-2099-0001" in ids
+
+    def test_lang_vuln_carries_bom_ref(self, bom_file, db_fixture,
+                                       tmp_path):
+        """Library vulns must keep the package's bom-ref so a
+        cyclonedx vuln-only report can link back into the source BOM
+        (regression: ref was dropped in _lib_vuln)."""
+        out_file = tmp_path / "report.cdx.json"
+        code, _ = self._run([
+            "sbom", bom_file, "--format", "cyclonedx",
+            "--output", str(out_file), "--db-fixtures", db_fixture,
+            "--backend", "cpu", "--no-cache",
+            "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        doc = json.loads(out_file.read_text())
+        refs = {v["id"]: v["affects"][0]["ref"]
+                for v in doc["vulnerabilities"]}
+        assert refs["CVE-2099-0001"].endswith(
+            "#pkg:composer/pear/log@1.13.1")
+
+    def test_unknown_format_fails(self, tmp_path):
+        p = tmp_path / "notbom.txt"
+        p.write_text("hello")
+        code, _ = self._run(["sbom", str(p), "--no-cache",
+                             "--cache-dir", str(tmp_path / "c")])
+        assert code == 1

@@ -9,7 +9,8 @@ TPU dispatch over every layer's secret candidates.
 from .artifact import ArtifactOption, ImageArtifact, LocalFSArtifact
 from .cache import FSCache, MemoryCache, calc_key
 from .image import ImageSource, load_image
+from .sbom import SBOMArtifact
 
 __all__ = ["ArtifactOption", "ImageArtifact", "LocalFSArtifact",
            "FSCache", "MemoryCache", "calc_key", "ImageSource",
-           "load_image"]
+           "load_image", "SBOMArtifact"]

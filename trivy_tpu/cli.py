@@ -1,8 +1,8 @@
 """Command-line interface (reference: pkg/commands/app.go).
 
 Subcommands mirror the reference's cobra tree: image, filesystem
-(alias fs), rootfs, db build, version — flags follow the same names
-so invocations port over (``--severity``, ``--security-checks``,
+(alias fs), rootfs, sbom, db build, version — flags follow the same
+names so invocations port over (``--severity``, ``--security-checks``,
 ``--format``, ``--ignore-unfixed``, ``--skip-dirs`` …), plus
 ``--backend tpu|cpu|cpu-ref`` selecting the kernel dispatch path.
 """
@@ -48,9 +48,13 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--severity", "-s", default=DEFAULT_SEVERITIES)
         sp.add_argument("--security-checks", default="vuln,secret")
         sp.add_argument("--vuln-type", default="os,library")
+        from .report.writer import FORMATS
         sp.add_argument("--format", "-f", default="table",
-                        choices=["table", "json"])
+                        choices=FORMATS)
         sp.add_argument("--output", "-o", default="")
+        sp.add_argument("--template", "-t", default="",
+                        help="output template ('@path' or inline), "
+                        "used with --format template")
         sp.add_argument("--ignore-unfixed", action="store_true")
         sp.add_argument("--ignorefile", default=".trivyignore")
         sp.add_argument("--exit-code", type=int, default=0)
@@ -87,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
     rootfs.add_argument("target")
     scan_flags(rootfs)
 
+    sbom = sub.add_parser("sbom", help="scan an SBOM document "
+                          "(CycloneDX/SPDX, vuln checks only)")
+    sbom.add_argument("target")
+    scan_flags(sbom)
+
     db = sub.add_parser("db", help="advisory DB operations")
     dbsub = db.add_subparsers(dest="db_command")
     build = dbsub.add_parser(
@@ -110,6 +119,8 @@ def main(argv=None) -> int:
         return run_image(args)
     if args.command in ("filesystem", "fs", "rootfs"):
         return run_fs(args)
+    if args.command == "sbom":
+        return run_sbom(args)
     if args.command == "db":
         return run_db(args)
     return 2
@@ -172,12 +183,18 @@ def _artifact_option(args) -> ArtifactOption:
     )
 
 
+_SBOM_FORMATS = ("cyclonedx", "spdx", "spdx-json", "github")
+
+
 def _scan_options(args) -> ScanOptions:
     return ScanOptions(
         vuln_type=[v for v in args.vuln_type.split(",") if v],
         security_checks=[c for c in
                          args.security_checks.split(",") if c],
-        list_all_packages=args.list_all_pkgs,
+        # SBOM interchange formats need the full package inventory
+        # (ref pkg/commands/artifact/run.go ListAllPkgs override)
+        list_all_packages=args.list_all_pkgs or
+        args.format in _SBOM_FORMATS,
         backend="cpu-ref" if args.backend == "cpu-ref" else args.backend,
     )
 
@@ -192,7 +209,12 @@ def _finish(args, report: Report) -> int:
     try:
         write_report(report, fmt=args.format, output=out,
                      severities=[str(s) for s in
-                                 _severities(args.severity)])
+                                 _severities(args.severity)],
+                     app_version=__version__,
+                     output_template=getattr(args, "template", ""))
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     finally:
         if args.output:
             out.close()
@@ -243,6 +265,39 @@ def run_image(args) -> int:
             image_config=ref.image_metadata.image_config,
         ),
         results=results,
+    )
+    return _finish(args, report)
+
+
+def run_sbom(args) -> int:
+    """Scan an SBOM file (ref pkg/commands/artifact/run.go sbomScanner:
+    vulnerability checks only)."""
+    from .artifact.sbom import SBOMArtifact
+    if not os.path.isfile(args.target):
+        print(f"error: no such file: {args.target}", file=sys.stderr)
+        return 1
+    cache = _cache(args)
+    # vuln-only scan: no analyzers or secret stack needed
+    artifact = SBOMArtifact(args.target, cache,
+                            option=ArtifactOption(scan_secrets=False))
+    try:
+        ref = artifact.inspect()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    scanner = LocalScanner(cache, _store(args))
+    options = _scan_options(args)
+    options.security_checks = ["vuln"]
+    results, os_found = scanner.scan(
+        ScanTarget(name=ref.name, artifact_id=ref.id,
+                   blob_ids=ref.blob_ids),
+        options)
+    report = Report(
+        artifact_name=args.target,
+        artifact_type=ref.type,
+        metadata=Metadata(os=os_found),
+        results=results,
+        cyclonedx=ref.cyclonedx,
     )
     return _finish(args, report)
 

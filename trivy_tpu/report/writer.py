@@ -1,25 +1,77 @@
-"""json + table writers."""
+"""Report format dispatch (reference: pkg/report/writer.go:58-98).
+
+Formats: table, json, sarif, cyclonedx, spdx, spdx-json, github,
+cosign-vuln, template.
+"""
 
 from __future__ import annotations
 
 import json
 import sys
+from datetime import datetime, timezone
 from typing import Optional
 
 from ..types import Report, Severity
 
 _SEV_ORDER = ["CRITICAL", "HIGH", "MEDIUM", "LOW", "UNKNOWN"]
 
+FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json",
+           "github", "cosign-vuln", "template"]
+
 
 def write_report(report: Report, fmt: str = "table",
-                 output=None, severities: Optional[list] = None)\
-        -> None:
+                 output=None, severities: Optional[list] = None,
+                 app_version: str = "dev",
+                 output_template: str = "") -> None:
     out = output or sys.stdout
     if fmt == "json":
         json.dump(report.to_dict(), out, indent=2)
         out.write("\n")
     elif fmt == "table":
         out.write(render_table(report, severities))
+    elif fmt == "sarif":
+        from .sarif import SarifWriter
+        SarifWriter(out, version=app_version).write(report)
+    elif fmt == "cyclonedx":
+        from ..sbom.cyclonedx import Marshaler
+        m = Marshaler(app_version=app_version)
+        # an SBOM rescan exports only vulnerabilities referencing the
+        # original BOM (ref report/cyclonedx/cyclonedx.go:36-41)
+        if report.artifact_type == "cyclonedx" and report.cyclonedx:
+            bom = m.marshal_vulnerabilities(report)
+        else:
+            bom = m.marshal(report)
+        json.dump(bom, out, indent=2)
+        out.write("\n")
+    elif fmt in ("spdx", "spdx-json"):
+        from ..sbom.spdx import Marshaler
+        m = Marshaler()
+        if fmt == "spdx":
+            out.write(m.marshal_tv(report))
+        else:
+            json.dump(m.marshal(report), out, indent=2)
+            out.write("\n")
+    elif fmt == "github":
+        from .github import GithubWriter
+        GithubWriter(out, version=app_version).write(report)
+    elif fmt == "cosign-vuln":
+        now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        predicate = {
+            "invocation": {"parameters": None, "uri": "",
+                           "event_id": "", "builder.id": ""},
+            "scanner": {
+                "uri": f"pkg:github/aquasecurity/trivy@{app_version}",
+                "version": app_version,
+                "db": {"uri": "", "version": ""},
+                "result": report.to_dict(),
+            },
+            "metadata": {"scanStartedOn": now, "scanFinishedOn": now},
+        }
+        json.dump(predicate, out, indent=2)
+        out.write("\n")
+    elif fmt == "template":
+        from .template import TemplateWriter
+        TemplateWriter(out, output_template).write(report)
     else:
         raise ValueError(f"unknown format: {fmt}")
 

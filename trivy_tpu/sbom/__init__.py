@@ -1,0 +1,150 @@
+"""SBOM format sniffing + decode (ref pkg/sbom/sbom.go).
+
+``detect_format`` probes the raw bytes the same way the reference
+probes the reader: CycloneDX JSON (bomFormat), CycloneDX XML (xmlns),
+SPDX JSON (SPDXID), SPDX tag-value (first line), then a DSSE-enveloped
+in-toto attestation carrying a CycloneDX predicate.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import xml.etree.ElementTree as ET
+
+from .cyclonedx import DecodedSBOM
+from . import cyclonedx as cdx
+from . import spdx as spdx_mod
+
+FORMAT_CYCLONEDX_JSON = "cyclonedx-json"
+FORMAT_CYCLONEDX_XML = "cyclonedx-xml"
+FORMAT_SPDX_JSON = "spdx-json"
+FORMAT_SPDX_TV = "spdx-tv"
+FORMAT_ATTEST_CYCLONEDX_JSON = "attest-cyclonedx-json"
+FORMAT_UNKNOWN = "unknown"
+
+IN_TOTO_PAYLOAD_TYPE = "application/vnd.in-toto+json"
+PREDICATE_CYCLONEDX = "https://cyclonedx.org/bom"
+
+
+def detect_format(data: bytes) -> str:
+    """Sniff the SBOM format (sbom.go:33-107)."""
+    try:
+        doc = json.loads(data)
+    except (ValueError, UnicodeDecodeError):
+        doc = None
+    if isinstance(doc, dict):
+        if doc.get("bomFormat") == "CycloneDX":
+            return FORMAT_CYCLONEDX_JSON
+        if str(doc.get("SPDXID", "")).startswith("SPDX"):
+            return FORMAT_SPDX_JSON
+        if doc.get("payloadType") == IN_TOTO_PAYLOAD_TYPE:
+            try:
+                stmt = json.loads(
+                    base64.b64decode(doc.get("payload", "")))
+            except (ValueError, UnicodeDecodeError):
+                stmt = {}
+            if stmt.get("predicateType") == PREDICATE_CYCLONEDX:
+                return FORMAT_ATTEST_CYCLONEDX_JSON
+        return FORMAT_UNKNOWN
+
+    stripped = data.lstrip()
+    if stripped.startswith(b"<"):
+        try:
+            root = ET.fromstring(data)
+        except ET.ParseError:
+            return FORMAT_UNKNOWN
+        if root.tag.startswith("{http://cyclonedx.org"):
+            return FORMAT_CYCLONEDX_XML
+        return FORMAT_UNKNOWN
+
+    first = data.split(b"\n", 1)[0].strip()
+    if first.startswith(b"SPDX"):
+        return FORMAT_SPDX_TV
+    return FORMAT_UNKNOWN
+
+
+def decode(data: bytes, fmt: str) -> DecodedSBOM:
+    """Decode SBOM bytes in the given format (sbom.go:109-148)."""
+    if fmt == FORMAT_CYCLONEDX_JSON:
+        return cdx.unmarshal(json.loads(data))
+    if fmt == FORMAT_CYCLONEDX_XML:
+        return cdx.unmarshal(_xml_to_doc(data))
+    if fmt == FORMAT_ATTEST_CYCLONEDX_JSON:
+        envelope = json.loads(data)
+        if envelope.get("payloadType") != IN_TOTO_PAYLOAD_TYPE:
+            raise ValueError(
+                f"invalid attestation payload type: "
+                f"{envelope.get('payloadType')}")
+        stmt = json.loads(base64.b64decode(envelope.get("payload", "")))
+        predicate = stmt.get("predicate") or {}
+        # cosign wraps the BOM in a custom predicate {Data: <bom>}
+        bom = predicate.get("Data", predicate)
+        if isinstance(bom, str):
+            bom = json.loads(bom)
+        return cdx.unmarshal(bom)
+    if fmt == FORMAT_SPDX_JSON:
+        return spdx_mod.unmarshal(json.loads(data))
+    if fmt == FORMAT_SPDX_TV:
+        return spdx_mod.unmarshal(
+            spdx_mod.parse_tag_value(data.decode("utf-8", "replace")))
+    raise ValueError(f"{fmt} scanning is not yet supported")
+
+
+def _xml_to_doc(data: bytes) -> dict:
+    """CycloneDX XML → the dict shape the JSON decoder uses."""
+    ns = "{http://cyclonedx.org/schema/bom/1.4}"
+    root = ET.fromstring(data)
+    if not root.tag.startswith("{http://cyclonedx.org"):
+        raise ValueError("not a CycloneDX XML document")
+    ns = root.tag.split("}")[0] + "}"
+
+    def text(el, tag):
+        child = el.find(ns + tag)
+        return child.text or "" if child is not None else ""
+
+    def conv_component(el):
+        comp = {
+            "bom-ref": el.get("bom-ref", ""),
+            "type": el.get("type", ""),
+            "name": text(el, "name"),
+            "version": text(el, "version"),
+            "purl": text(el, "purl"),
+        }
+        lic_el = el.find(ns + "licenses")
+        if lic_el is not None:
+            licenses = []
+            for le in lic_el:
+                if le.tag == ns + "expression":
+                    licenses.append({"expression": le.text or ""})
+                else:
+                    licenses.append({"license": {
+                        "name": text(le, "name") or text(le, "id")}})
+            comp["licenses"] = licenses
+        props_el = el.find(ns + "properties")
+        if props_el is not None:
+            comp["properties"] = [
+                {"name": pe.get("name", ""), "value": pe.text or ""}
+                for pe in props_el.findall(ns + "property")]
+        return comp
+
+    doc = {"bomFormat": "CycloneDX",
+           "specVersion": root.get("version", ""),
+           "serialNumber": root.get("serialNumber", "")}
+    meta_el = root.find(ns + "metadata")
+    if meta_el is not None:
+        mc = meta_el.find(ns + "component")
+        if mc is not None:
+            doc["metadata"] = {"component": conv_component(mc)}
+    comps_el = root.find(ns + "components")
+    if comps_el is not None:
+        doc["components"] = [conv_component(c) for c in
+                             comps_el.findall(ns + "component")]
+    deps_el = root.find(ns + "dependencies")
+    if deps_el is not None:
+        doc["dependencies"] = [
+            {"ref": d.get("ref", ""),
+             "dependsOn": [dd.get("ref", "") for dd in
+                           d.findall(ns + "dependency")]}
+            for d in deps_el.findall(ns + "dependency")]
+    return doc

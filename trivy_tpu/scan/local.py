@@ -267,6 +267,7 @@ class LocalScanner:
             installed_version=lib.version,
             fixed_version=_fixed_versions(adv),
             layer=lib.layer,
+            ref=lib.ref,
             data_source=adv.data_source,
         )
 

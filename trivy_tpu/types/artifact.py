@@ -256,6 +256,9 @@ class ArtifactReference:
     id: str = ""
     blob_ids: list = field(default_factory=list)
     image_metadata: Optional[ImageMetadata] = None
+    # original BOM header for SBOM artifacts (ref artifact.go:44-47
+    # ArtifactReference.CycloneDX)
+    cyclonedx: Optional[dict] = None
 
 
 @dataclass

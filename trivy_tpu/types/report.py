@@ -251,9 +251,14 @@ class Report:
     metadata: Metadata = jfield("Metadata", default_factory=Metadata,
                                 keep=True)
     results: list = jfield("Results", default_factory=list)
+    # original CycloneDX header kept for SBOM rescans — never
+    # serialized (ref pkg/types Report.CycloneDX `json:"-"`)
+    cyclonedx: Optional[dict] = field(default=None)
 
     def to_dict(self) -> dict:
-        return asdict_omitempty(self)
+        d = asdict_omitempty(self)
+        d.pop("cyclonedx", None)
+        return d
 
 
 @dataclass
