@@ -248,6 +248,15 @@ class TestTemplate:
         assert '<testcase name="CVE-2019-14697[CRITICAL]"/>' in out
 
 
+def test_template_trim_markers():
+    """`{{-`/`-}}` must strip adjacent whitespace like go-template."""
+    from trivy_tpu.report.template import Template
+    assert Template("a\n{{- .X }}").render({"X": "b"}) == "ab"
+    assert Template("{{ .X -}}  \n c").render({"X": "b"}) == "bc"
+    assert Template(
+        "{{- range . }}x{{ end -}}\n").render([1, 2]) == "xx"
+
+
 class TestTemplateErrors:
     def test_missing_template_flag(self):
         with pytest.raises(ValueError, match="requires"):

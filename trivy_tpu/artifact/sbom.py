@@ -22,6 +22,28 @@ from .artifact import ArtifactOption
 log = get_logger("artifact.sbom")
 
 
+def decode_to_blob(data: bytes):
+    """One-pass decode of SBOM bytes into the cacheable unit:
+    ``(artifact_type, decoded, blob, blob_id)``. The blob id is the
+    sha256 of the canonical blob JSON, so identical SBOMs dedup in the
+    cache. Shared by SBOMArtifact and BatchScanRunner.scan_boms.
+    Raises ValueError on unknown format."""
+    fmt, decoded = sbom_mod.sniff_and_decode(data)
+    blob = BlobInfo(
+        os=decoded.os,
+        package_infos=decoded.packages,
+        applications=decoded.applications,
+    )
+    raw = json.dumps(blob.to_dict(), sort_keys=True).encode()
+    blob_id = "sha256:" + hashlib.sha256(raw).hexdigest()
+    artifact_type = "cyclonedx" if fmt in (
+        sbom_mod.FORMAT_CYCLONEDX_JSON,
+        sbom_mod.FORMAT_CYCLONEDX_XML,
+        sbom_mod.FORMAT_ATTEST_CYCLONEDX_JSON) else "spdx"
+    log.debug("decoded SBOM format %s -> %s", fmt, blob_id[:19])
+    return artifact_type, decoded, blob, blob_id
+
+
 class SBOMArtifact:
     def __init__(self, file_path: str, cache,
                  option: Optional[ArtifactOption] = None):
@@ -32,29 +54,12 @@ class SBOMArtifact:
     def inspect(self) -> ArtifactReference:
         with open(self.file_path, "rb") as f:
             data = f.read()
-        fmt = sbom_mod.detect_format(data)
-        if fmt == sbom_mod.FORMAT_UNKNOWN:
-            raise ValueError(
-                f"failed to detect SBOM format: {self.file_path}")
-        log.info("detected SBOM format: %s", fmt)
-        decoded = sbom_mod.decode(data, fmt)
-
-        blob = BlobInfo(
-            os=decoded.os,
-            package_infos=decoded.packages,
-            applications=decoded.applications,
-        )
-        raw = json.dumps(blob.to_dict(), sort_keys=True).encode()
-        blob_id = "sha256:" + hashlib.sha256(raw).hexdigest()
+        try:
+            artifact_type, decoded, blob, blob_id = \
+                decode_to_blob(data)
+        except ValueError as e:
+            raise ValueError(f"{e}: {self.file_path}")
         self.cache.put_blob(blob_id, blob)
-
-        if fmt in (sbom_mod.FORMAT_CYCLONEDX_JSON,
-                   sbom_mod.FORMAT_CYCLONEDX_XML,
-                   sbom_mod.FORMAT_ATTEST_CYCLONEDX_JSON):
-            artifact_type = "cyclonedx"
-        else:
-            artifact_type = "spdx"
-
         return ArtifactReference(
             name=self.file_path,
             type=artifact_type,

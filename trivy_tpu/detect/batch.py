@@ -74,6 +74,11 @@ class _RankSpace:
         return lo, hi
 
 
+# device-kernel wall time of the most recent dispatch_jobs call,
+# for the host/device split in bench + tracing
+last_dispatch_stats: dict = {"device_s": 0.0}
+
+
 def detect_pairs(jobs: list, backend: str = "tpu",
                  mesh=None) -> list:
     """Returns payloads of vulnerable pairs, batch order preserved.
@@ -124,6 +129,8 @@ def detect_pairs(jobs: list, backend: str = "tpu",
             for j, iv in enumerate(sec_ivs):
                 s_lo[i, j], s_hi[i, j] = sp.encode(iv)
             flags_arr[i] = flags
+        import time as _time
+        t0 = _time.perf_counter()
         if backend == "cpu-ref":
             hits = np.asarray(interval_hits_host(
                 pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
@@ -134,6 +141,8 @@ def detect_pairs(jobs: list, backend: str = "tpu",
         else:
             hits = np.asarray(_device_hits(
                 pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
+        last_dispatch_stats["device_s"] += \
+            _time.perf_counter() - t0
         out.extend(rows[i][0].payload for i in np.nonzero(hits)[0])
 
     # host fallback pairs: exact per-pair evaluation
@@ -271,8 +280,10 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
         rows.append(job.row)
 
     if kept:
+        import time as _time
         pkg_rank = np.asarray(ranks, np.int32)
         row_idx = np.asarray(rows, np.int32)
+        t0 = _time.perf_counter()
         if backend == "cpu-ref":
             hits = interval_hits_host(
                 pkg_rank, cdb.v_lo[row_idx], cdb.v_hi[row_idx],
@@ -290,6 +301,8 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
             tables = cdb.device_tables()
             hits = np.asarray(interval_hits_resident(
                 jnp.asarray(pkg_rank), jnp.asarray(row_idx), *tables))
+        last_dispatch_stats["device_s"] += \
+            _time.perf_counter() - t0
         out.extend(kept[i].payload for i in np.nonzero(hits)[0])
 
     for job in host:
@@ -302,6 +315,7 @@ def dispatch_jobs(jobs: list, backend: str = "tpu",
                   mesh=None) -> list:
     """Mixed-job dispatcher: classic PairJobs (per-dispatch compile)
     and ResidentPairJobs (compiled store), each in one kernel call."""
+    last_dispatch_stats["device_s"] = 0.0
     plain = [j for j in jobs if isinstance(j, PairJob)]
     resident = [j for j in jobs if isinstance(j, ResidentPairJob)]
     out = detect_pairs(plain, backend=backend, mesh=mesh) \

@@ -15,24 +15,12 @@ from typing import Optional
 
 from ...types import DetectedVulnerability, Vulnerability
 from ...types.common import SEVERITIES
+from ...types.common import format_evr as format_version  # noqa: F401
+from ...types.common import format_src_version  # noqa: F401
 from ...utils import get_logger
 from ...vercmp import get_comparer
 
 log = get_logger("detect.ospkg")
-
-
-def format_version(epoch, version, release) -> str:
-    v = version or ""
-    if release:
-        v = f"{v}-{release}"
-    if epoch:
-        v = f"{epoch}:{v}"
-    return v
-
-
-def format_src_version(pkg) -> str:
-    return format_version(pkg.src_epoch, pkg.src_version,
-                          pkg.src_release)
 
 
 def _severity_name(value: int) -> str:

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from urllib.parse import quote, unquote
 
 from trivy_tpu.types.artifact import OS, Package
+from trivy_tpu.types.common import format_pkg_version
 
 TYPE_APK = "apk"
 TYPE_DEB = "deb"
@@ -217,15 +218,6 @@ def from_string(s: str) -> PackageURL:
                       subpath=subpath)
 
 
-def _format_version(pkg: Package) -> str:
-    v = pkg.version or ""
-    if pkg.release:
-        v = f"{v}-{pkg.release}"
-    if pkg.epoch:
-        v = f"{pkg.epoch}:{v}"
-    return v
-
-
 def _split_ns(name: str):
     if "/" in name:
         ns, _, base = name.rpartition("/")
@@ -247,7 +239,7 @@ def new_package_url(pkg_type: str, pkg: Package, os: OS = None,
 
     ptype = _purl_type(pkg_type)
     name = pkg.name
-    version = _format_version(pkg)
+    version = format_pkg_version(pkg)
     namespace = ""
 
     if ptype == TYPE_RPM:

@@ -9,6 +9,7 @@ from datetime import datetime, timezone
 
 from .. import purl as purl_mod
 from ..types import Report
+from ..types.common import class_str
 
 
 class GithubWriter:
@@ -33,8 +34,7 @@ class GithubWriter:
             if not result.packages:
                 continue
             manifest = {"name": result.type}
-            if getattr(result.class_, "value",
-                       str(result.class_)) == "lang-pkgs":
+            if class_str(result.class_) == "lang-pkgs":
                 manifest["file"] = {"source_location": result.target}
             resolved = {}
             for pkg in result.packages:

@@ -12,6 +12,7 @@ import json
 import re
 
 from ..types import Report
+from ..types.common import class_str
 
 _RULE_NAMES = {
     "os-pkgs": "OsPackageVulnerability",
@@ -114,9 +115,8 @@ class SarifWriter:
     def write(self, report: Report) -> None:
         for result in report.results:
             target = to_path_uri(result.target)
-            rule_name = _RULE_NAMES.get(
-                getattr(result.class_, "value", str(result.class_)),
-                "UnknownIssue")
+            rule_name = _RULE_NAMES.get(class_str(result.class_),
+                                        "UnknownIssue")
             for v in result.vulnerabilities:
                 detail = v.vulnerability
                 title = detail.title if detail else ""

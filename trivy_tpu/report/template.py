@@ -83,17 +83,28 @@ class _Assign(_Node):
 
 
 def _tokenize(src: str):
+    """Yields text/action tokens with go-template `-` whitespace
+    trimming already applied to the surrounding text."""
+    tokens = []
     pos = 0
     for m in _TOKEN_RE.finditer(src):
         if m.start() > pos:
-            yield ("text", src[pos:m.start()])
+            tokens.append(["text", src[pos:m.start()]])
         raw = src[m.start():m.end()]
         text = m.group(1).strip()
-        yield ("action", text, raw.startswith("{{-"),
-               raw.endswith("-}}"))
+        if raw.startswith("{{-") and tokens and \
+                tokens[-1][0] == "text":
+            tokens[-1][1] = tokens[-1][1].rstrip()
+        tokens.append(("action", text, raw.endswith("-}}")))
         pos = m.end()
     if pos < len(src):
-        yield ("text", src[pos:])
+        tokens.append(["text", src[pos:]])
+    for i, tok in enumerate(tokens):
+        if tok[0] == "action" and tok[2] and \
+                i + 1 < len(tokens) and tokens[i + 1][0] == "text":
+            tokens[i + 1][1] = tokens[i + 1][1].lstrip()
+    for tok in tokens:
+        yield tuple(tok[:2]) if tok[0] == "text" else tok
 
 
 def _parse(tokens, stop=("end",)):

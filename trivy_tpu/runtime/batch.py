@@ -58,6 +58,7 @@ class BatchScanRunner:
                 backend="cpu-ref" if backend == "cpu-ref" else "tpu",
                 mesh=mesh)
         self.secret_scanner = secret_scanner
+        self.last_stats: dict = {}   # phase timings of the last batch
 
     def scan_paths(self, paths: list,
                    options: Optional[ScanOptions] = None) -> list:
@@ -76,25 +77,34 @@ class BatchScanRunner:
 
     def scan_images(self, images: list,
                     options: Optional[ScanOptions] = None) -> list:
+        import time as _time
         options = options or ScanOptions(backend=self.backend)
         scan_secrets = "secret" in options.security_checks
 
         # ---- phase 1: analyze missing layers, collect candidates ----
+        t0 = _time.perf_counter()
         artifacts = []
         opt = ArtifactOption(scan_secrets=scan_secrets)
         for img in images:
             a = _CollectingImageArtifact(img, self.cache, opt)
             a.reference = a.inspect()
             artifacts.append(a)
+        analyze_s = _time.perf_counter() - t0
 
         # ---- phase 2: ONE sieve dispatch over all images ----
+        t0 = _time.perf_counter()
         collected = [c for a in artifacts for c in a.collected]
+        sec_stats: dict = {}       # only this batch's, never stale
         if scan_secrets and collected:
             found = self.secret_scanner.scan_files(
                 [(p, c) for _, p, c in collected])
             _patch_blobs(self.cache, artifacts, found)
+            sec_stats = dict(getattr(self.secret_scanner,
+                                     "stats", {}))
+        secret_s = _time.perf_counter() - t0
 
         # ---- phase 3: squash + advisory join (host) ----
+        t0 = _time.perf_counter()
         scanner = LocalScanner(self.cache, self.store)
         prepared = []
         for a in artifacts:
@@ -102,8 +112,10 @@ class BatchScanRunner:
             prepared.append(scanner.prepare(
                 ScanTarget(name=ref.name, artifact_id=ref.id,
                            blob_ids=ref.blob_ids), options))
+        join_s = _time.perf_counter() - t0
 
         # ---- phase 4: ONE interval dispatch over all images ----
+        t0 = _time.perf_counter()
         all_jobs = []
         for idx, p in enumerate(prepared):
             for job in p.jobs:
@@ -114,6 +126,21 @@ class BatchScanRunner:
                                           backend=options.backend,
                                           mesh=self.mesh):
             detected_by_image.setdefault(idx, []).append(payload)
+        interval_s = _time.perf_counter() - t0
+
+        from ..detect import batch as detect_batch
+        self.last_stats = {
+            "images": len(images),
+            "analyze_s": round(analyze_s, 4),
+            "secret_batch_s": round(secret_s, 4),
+            "squash_join_s": round(join_s, 4),
+            "interval_dispatch_s": round(interval_s, 4),
+            "interval_device_s": round(
+                detect_batch.last_dispatch_stats.get(
+                    "device_s", 0.0), 4),
+            "interval_jobs": len(all_jobs),
+            "secret": sec_stats,
+        }
 
         # ---- phase 5: assemble per image ----
         out = []
@@ -136,6 +163,80 @@ class BatchScanRunner:
                     results=results,
                 )))
         return out
+
+
+    def scan_boms(self, boms: list,
+                  options: Optional[ScanOptions] = None) -> list:
+        """Batch-scan SBOM documents: ``boms`` is a list of
+        (name, raw-bytes). BASELINE config #4's shape — no tar
+        walking, no analyzers: decode → name-join → ONE interval
+        dispatch for the whole fleet against the resident advisory
+        tables."""
+        import time as _time
+
+        from ..artifact.sbom import decode_to_blob
+
+        options = options or ScanOptions(
+            backend=self.backend, security_checks=["vuln"])
+
+        # ---- phase 1: decode + blob (host) ----
+        t0 = _time.perf_counter()
+        scanner = LocalScanner(self.cache, self.store)
+        prepared, metas, failures = [], [], {}
+        for i, (name, data) in enumerate(boms):
+            try:
+                atype, decoded, blob, blob_id = decode_to_blob(data)
+            except (ValueError, KeyError, AttributeError,
+                    TypeError) as e:
+                # malformed-but-sniffable documents must fail their
+                # own slot, never the fleet
+                failures[i] = BatchScanResult(name=name, error=str(e))
+                continue
+            self.cache.put_blob(blob_id, blob)
+            prepared.append((i, scanner.prepare(
+                ScanTarget(name=name, artifact_id=blob_id,
+                           blob_ids=[blob_id]), options)))
+            metas.append((i, name, atype, decoded))
+        decode_s = _time.perf_counter() - t0
+
+        # ---- phase 2: ONE interval dispatch over all SBOMs ----
+        t0 = _time.perf_counter()
+        all_jobs = []
+        for idx, (_, p) in enumerate(prepared):
+            for job in p.jobs:
+                job.payload = (idx, job.payload)
+                all_jobs.append(job)
+        detected: dict = {}
+        for idx, payload in dispatch_jobs(all_jobs,
+                                          backend=options.backend,
+                                          mesh=self.mesh):
+            detected.setdefault(idx, []).append(payload)
+        interval_s = _time.perf_counter() - t0
+
+        # ---- phase 3: assemble ----
+        out = dict(failures)
+        for idx, ((i, p), (_, name, atype, decoded)) in \
+                enumerate(zip(prepared, metas)):
+            results, os_found = scanner.finish(
+                p, detected.get(idx, []))
+            out[i] = BatchScanResult(
+                name=name,
+                report=Report(artifact_name=name,
+                              artifact_type=atype,
+                              metadata=Metadata(os=os_found),
+                              results=results,
+                              cyclonedx=decoded.cyclonedx))
+        from ..detect import batch as detect_batch
+        self.last_stats = {
+            "sboms": len(boms),
+            "decode_s": round(decode_s, 4),
+            "interval_dispatch_s": round(interval_s, 4),
+            "interval_device_s": round(
+                detect_batch.last_dispatch_stats.get(
+                    "device_s", 0.0), 4),
+            "interval_jobs": len(all_jobs),
+        }
+        return [out[i] for i in range(len(boms))]
 
 
 class _CollectingImageArtifact(ImageArtifact):

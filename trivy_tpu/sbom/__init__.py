@@ -27,17 +27,19 @@ IN_TOTO_PAYLOAD_TYPE = "application/vnd.in-toto+json"
 PREDICATE_CYCLONEDX = "https://cyclonedx.org/bom"
 
 
-def detect_format(data: bytes) -> str:
-    """Sniff the SBOM format (sbom.go:33-107)."""
+def _sniff(data: bytes):
+    """Sniff the SBOM format, returning ``(fmt, parsed)`` so decode
+    never parses the same bytes twice (sbom.go:33-107). ``parsed`` is
+    the json document, the XML root element, or the raw text."""
     try:
         doc = json.loads(data)
     except (ValueError, UnicodeDecodeError):
         doc = None
     if isinstance(doc, dict):
         if doc.get("bomFormat") == "CycloneDX":
-            return FORMAT_CYCLONEDX_JSON
+            return FORMAT_CYCLONEDX_JSON, doc
         if str(doc.get("SPDXID", "")).startswith("SPDX"):
-            return FORMAT_SPDX_JSON
+            return FORMAT_SPDX_JSON, doc
         if doc.get("payloadType") == IN_TOTO_PAYLOAD_TYPE:
             try:
                 stmt = json.loads(
@@ -45,38 +47,41 @@ def detect_format(data: bytes) -> str:
             except (ValueError, UnicodeDecodeError):
                 stmt = {}
             if stmt.get("predicateType") == PREDICATE_CYCLONEDX:
-                return FORMAT_ATTEST_CYCLONEDX_JSON
-        return FORMAT_UNKNOWN
+                return FORMAT_ATTEST_CYCLONEDX_JSON, doc
+        return FORMAT_UNKNOWN, None
 
     stripped = data.lstrip()
     if stripped.startswith(b"<"):
         try:
             root = ET.fromstring(data)
         except ET.ParseError:
-            return FORMAT_UNKNOWN
+            return FORMAT_UNKNOWN, None
         if root.tag.startswith("{http://cyclonedx.org"):
-            return FORMAT_CYCLONEDX_XML
-        return FORMAT_UNKNOWN
+            return FORMAT_CYCLONEDX_XML, root
+        return FORMAT_UNKNOWN, None
 
     first = data.split(b"\n", 1)[0].strip()
     if first.startswith(b"SPDX"):
-        return FORMAT_SPDX_TV
-    return FORMAT_UNKNOWN
+        return FORMAT_SPDX_TV, data.decode("utf-8", "replace")
+    return FORMAT_UNKNOWN, None
 
 
-def decode(data: bytes, fmt: str) -> DecodedSBOM:
-    """Decode SBOM bytes in the given format (sbom.go:109-148)."""
+def detect_format(data: bytes) -> str:
+    """Sniff the SBOM format (sbom.go:33-107)."""
+    return _sniff(data)[0]
+
+
+def _decode_parsed(fmt: str, parsed) -> DecodedSBOM:
     if fmt == FORMAT_CYCLONEDX_JSON:
-        return cdx.unmarshal(json.loads(data))
+        return cdx.unmarshal(parsed)
     if fmt == FORMAT_CYCLONEDX_XML:
-        return cdx.unmarshal(_xml_to_doc(data))
+        return cdx.unmarshal(_xml_to_doc(parsed))
     if fmt == FORMAT_ATTEST_CYCLONEDX_JSON:
-        envelope = json.loads(data)
-        if envelope.get("payloadType") != IN_TOTO_PAYLOAD_TYPE:
+        if parsed.get("payloadType") != IN_TOTO_PAYLOAD_TYPE:
             raise ValueError(
                 f"invalid attestation payload type: "
-                f"{envelope.get('payloadType')}")
-        stmt = json.loads(base64.b64decode(envelope.get("payload", "")))
+                f"{parsed.get('payloadType')}")
+        stmt = json.loads(base64.b64decode(parsed.get("payload", "")))
         predicate = stmt.get("predicate") or {}
         # cosign wraps the BOM in a custom predicate {Data: <bom>}
         bom = predicate.get("Data", predicate)
@@ -84,17 +89,39 @@ def decode(data: bytes, fmt: str) -> DecodedSBOM:
             bom = json.loads(bom)
         return cdx.unmarshal(bom)
     if fmt == FORMAT_SPDX_JSON:
-        return spdx_mod.unmarshal(json.loads(data))
+        return spdx_mod.unmarshal(parsed)
     if fmt == FORMAT_SPDX_TV:
-        return spdx_mod.unmarshal(
-            spdx_mod.parse_tag_value(data.decode("utf-8", "replace")))
+        return spdx_mod.unmarshal(spdx_mod.parse_tag_value(parsed))
     raise ValueError(f"{fmt} scanning is not yet supported")
 
 
-def _xml_to_doc(data: bytes) -> dict:
-    """CycloneDX XML → the dict shape the JSON decoder uses."""
-    ns = "{http://cyclonedx.org/schema/bom/1.4}"
-    root = ET.fromstring(data)
+def decode(data: bytes, fmt: str) -> DecodedSBOM:
+    """Decode SBOM bytes in the given format (sbom.go:109-148)."""
+    sniffed, parsed = _sniff(data)
+    if sniffed != fmt:
+        raise ValueError(
+            f"{fmt} scanning is not yet supported"
+            if fmt not in (FORMAT_CYCLONEDX_JSON, FORMAT_CYCLONEDX_XML,
+                           FORMAT_ATTEST_CYCLONEDX_JSON,
+                           FORMAT_SPDX_JSON, FORMAT_SPDX_TV)
+            else f"document is not {fmt} (detected {sniffed})")
+    return _decode_parsed(fmt, parsed)
+
+
+def sniff_and_decode(data: bytes):
+    """One-pass detect + decode: ``(fmt, DecodedSBOM)``.
+    Raises ValueError on unknown format."""
+    fmt, parsed = _sniff(data)
+    if fmt == FORMAT_UNKNOWN:
+        raise ValueError("failed to detect SBOM format")
+    return fmt, _decode_parsed(fmt, parsed)
+
+
+def _xml_to_doc(root) -> dict:
+    """CycloneDX XML root element → the dict shape the JSON decoder
+    uses."""
+    if isinstance(root, (bytes, str)):
+        root = ET.fromstring(root)
     if not root.tag.startswith("{http://cyclonedx.org"):
         raise ValueError("not a CycloneDX XML document")
     ns = root.tag.split("}")[0] + "}"

@@ -19,6 +19,9 @@ from datetime import datetime, timezone
 from .. import purl as purl_mod
 from ..types import Report
 from ..types.artifact import OS, Application, Package, PackageInfo
+from ..types.common import (class_str as _class_str,
+                            format_pkg_version as _fmt_version,
+                            format_src_version as _fmt_src_version)
 from ..utils import get_logger
 
 log = get_logger("sbom.cyclonedx")
@@ -46,9 +49,6 @@ PROP_LAYER_DIFF_ID = "LayerDiffID"
 
 TIME_LAYOUT = "%Y-%m-%dT%H:%M:%S+00:00"
 
-
-def _class_str(c) -> str:
-    return getattr(c, "value", None) or str(c)
 
 # per-file installed-package types hang off the metadata component
 _AGGREGATE_TYPES = ("node-pkg", "python-pkg", "gobinary", "gemspec",
@@ -401,24 +401,6 @@ class Marshaler:
         else:
             comp["type"] = "application"
         return comp
-
-
-def _fmt_version(pkg: Package) -> str:
-    v = pkg.version or ""
-    if pkg.release:
-        v = f"{v}-{pkg.release}"
-    if pkg.epoch:
-        v = f"{pkg.epoch}:{v}"
-    return v
-
-
-def _fmt_src_version(pkg: Package) -> str:
-    v = pkg.src_version or ""
-    if pkg.src_release:
-        v = f"{v}-{pkg.src_release}"
-    if pkg.src_epoch:
-        v = f"{pkg.src_epoch}:{v}"
-    return v
 
 
 def _cdx_prop(key: str, value: str) -> dict:

@@ -21,6 +21,8 @@ from datetime import datetime, timezone
 from .. import purl as purl_mod
 from ..types import Report
 from ..types.artifact import OS, Application, PackageInfo
+from ..types.common import (class_str as _class_str,
+                            format_src_version as _fmt_src_version)
 from .cyclonedx import DecodedSBOM
 
 SPDX_VERSION = "SPDX-2.2"
@@ -30,15 +32,16 @@ DOC_NAMESPACE = "http://aquasecurity.github.io/trivy"
 SOURCE_PACKAGE_PREFIX = "built package from"
 
 REL_CONTAINS = "CONTAINS"
+# The SPDX spec spells this DESCRIBES; the reference emits "DESCRIBE"
+# (marshal.go:49) and its committed goldens contain it — parity with
+# the reference wins. The decoder ignores relationship types, so both
+# spellings round-trip.
 REL_DESCRIBE = "DESCRIBE"
 
 EL_OS = "OperatingSystem"
 EL_APP = "Application"
 EL_PKG = "Package"
 
-
-def _class_str(c) -> str:
-    return getattr(c, "value", None) or str(c)
 
 # per-file installed-package types whose FilePath is a target label,
 # not a lockfile path (unmarshal.go:139-151)
@@ -212,15 +215,6 @@ def parse_tag_value(text: str) -> dict:
 def _pkg_id(*parts) -> str:
     raw = json.dumps(parts, sort_keys=True, default=str).encode()
     return hashlib.sha256(raw).hexdigest()[:16]
-
-
-def _fmt_src_version(pkg) -> str:
-    v = pkg.src_version or ""
-    if pkg.src_release:
-        v = f"{v}-{pkg.src_release}"
-    if pkg.src_epoch:
-        v = f"{pkg.src_epoch}:{v}"
-    return v
 
 
 class Marshaler:

@@ -69,6 +69,8 @@ class BatchSecretScanner:
         # kernels need L % 128 == 0 (lane width / block reduction)
         self.seg_len = max(seg_len, 4 * self.overlap, 128)
         self.seg_len = ((self.seg_len + 127) // 128) * 128
+        self.stats: dict = {}
+        self._device_s = 0.0
 
     # --- segmenting ---
 
@@ -106,24 +108,45 @@ class BatchSecretScanner:
         entries with findings. Callers MUST map results back by the
         returned index, never by path: the same path routinely appears
         in several entries (every alpine image shares a file tree) and
-        path-based attribution misassigns findings across them."""
+        path-based attribution misassigns findings across them.
+
+        ``self.stats`` afterwards holds the sieve selectivity and the
+        host/device time split for this call (bench + tracing)."""
+        import time as _time
         entries = [
             _FileEntry(path=p, content=c, index=i)
             for i, (p, c) in enumerate(files)
         ]
+        t0 = _time.perf_counter()
         candidates = self._candidates(entries)
+        sieve_s = _time.perf_counter() - t0
 
+        t0 = _time.perf_counter()
         results = []
+        rules_verified = 0
         for fe in entries:
             rule_idxs = candidates.get(fe.index)
             if not rule_idxs:
                 continue
+            rules_verified += len(rule_idxs)
             rules = [self.scanner.rules[i] for i in sorted(rule_idxs)]
             sub = Scanner(rules, self.scanner.allow_rules,
                           self.scanner.exclude_block)
             secret = sub.scan(fe.path, fe.content)
             if secret.findings:
                 results.append((fe.index, secret))
+        verify_s = _time.perf_counter() - t0
+
+        self.stats = {
+            "files_total": len(entries),
+            "bytes_total": sum(len(fe.content) for fe in entries),
+            "files_gated": len(candidates),
+            "rules_verified": rules_verified,
+            "files_with_findings": len(results),
+            "sieve_s": round(sieve_s, 4),
+            "device_s": round(self._device_s, 4),
+            "verify_s": round(verify_s, 4),
+        }
         return results
 
     # --- sieve stages ---
@@ -131,11 +154,15 @@ class BatchSecretScanner:
     def _candidates(self, entries: list) -> dict:
         """file index → set of rule indices that must be scanned
         exactly."""
+        import time as _time
+        self._device_s = 0.0
         buf, seg_file, seg_pos = self._segment(entries)
         if buf.shape[0] == 0:
             return {}
+        t0 = _time.perf_counter()
         masks = run_blockmask(buf, self.plan.table,
                               backend=self.backend, mesh=self.mesh)
+        self._device_s += _time.perf_counter() - t0
 
         # run-hits dispatch is lazy: it fires at most once per batch,
         # and only when a run-gated rule survives its keyword gate
@@ -203,7 +230,9 @@ class BatchSecretScanner:
         specs = tuple(self.plan.run_specs)
         if not specs:
             return {}
+        import time as _time
         from ..ops.runs import make_run_hits, run_hits_host
+        t0 = _time.perf_counter()
         if self.backend == "cpu-ref":
             hits = run_hits_host(buf, specs)
         else:
@@ -211,6 +240,7 @@ class BatchSecretScanner:
             B = buf.shape[0]
             hits = np.asarray(
                 make_run_hits(specs)(pad_batch(buf)))[:B]
+        self._device_s += _time.perf_counter() - t0
         out: dict = {}
         for si, sp in zip(*np.nonzero(hits)):
             out.setdefault(seg_file[int(si)], set()).add(int(sp))

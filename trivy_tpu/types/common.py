@@ -48,6 +48,32 @@ class ResultClass(str, enum.Enum):
     CUSTOM = "custom"
 
 
+def class_str(c) -> str:
+    """ResultClass (or plain string) → its JSON value."""
+    return getattr(c, "value", None) or str(c)
+
+
+def format_evr(epoch, version, release) -> str:
+    """``[epoch:]version[-release]`` (reference:
+    pkg/scanner/utils FormatVersion core)."""
+    v = version or ""
+    if release:
+        v = f"{v}-{release}"
+    if epoch:
+        v = f"{epoch}:{v}"
+    return v
+
+
+def format_pkg_version(pkg) -> str:
+    """Binary package version string (utils.FormatVersion)."""
+    return format_evr(pkg.epoch, pkg.version, pkg.release)
+
+
+def format_src_version(pkg) -> str:
+    """Source package version string (utils.FormatSrcVersion)."""
+    return format_evr(pkg.src_epoch, pkg.src_version, pkg.src_release)
+
+
 def omitempty(v: Any) -> bool:
     """Go encoding/json omitempty predicate."""
     if v is None:
