@@ -414,11 +414,35 @@ def bench_images() -> dict:
 
 
 def bench_sboms() -> dict:
+    import tempfile
+
     from trivy_tpu.db import CompiledDB
+    from trivy_tpu.db.boltdb import load_trivy_db
     from trivy_tpu.runtime import BatchScanRunner
 
     rng = np.random.default_rng(20260731)
     store, n_adv = make_sbom_store(rng)
+
+    # round-trip the advisory set through the reference's native
+    # BoltDB format: fixture writer → production reader, so the
+    # ingest path is measured at full scale
+    from trivy_tpu.db.boltwriter import write_trivy_db
+    sources: dict = {}
+    for bucket, pkgs in store.buckets.items():
+        if bucket == "vulnerability":
+            continue
+        sources[bucket] = {p: dict(vulns)
+                           for p, vulns in pkgs.items()}
+    with tempfile.TemporaryDirectory() as tmp:
+        bolt_path = f"{tmp}/trivy.db"
+        write_trivy_db(bolt_path, sources, {})
+        t0 = time.perf_counter()
+        ingested, n_ing, _ = load_trivy_db(bolt_path)
+        boltdb_ingest_s = time.perf_counter() - t0
+    assert n_ing == n_adv, f"boltdb round-trip lost rows: " \
+        f"{n_ing} != {n_adv}"
+    store = ingested
+
     t0 = time.perf_counter()
     cdb = CompiledDB.compile(store)
     compile_s = time.perf_counter() - t0
@@ -454,6 +478,7 @@ def bench_sboms() -> dict:
         "sboms_per_sec": round(len(boms) / sbom_s, 1),
         "total_s": round(sbom_s, 2),
         "advisories": n_adv,
+        "boltdb_ingest_s": round(boltdb_ingest_s, 2),
         "db_compile_s": round(compile_s, 2),
         "host_fallback_rate": round(
             cdb.stats.get("host_fallback_rate", 0.0), 4),

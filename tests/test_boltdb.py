@@ -1,0 +1,138 @@
+"""BoltDB reader tests: page walking, inline buckets, branch pages,
+overflow values, trivy-db ingestion (mirrors trivy-db schema per
+SURVEY §2.3 / pkg/detector/library/driver.go:83-91 usage)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.db import boltwriter as bw
+from trivy_tpu.db.boltdb import BoltDB, CorruptDB, load_trivy_db
+
+
+@pytest.fixture()
+def tiny_db(tmp_path):
+    path = str(tmp_path / "trivy.db")
+    bw.write_trivy_db(
+        path,
+        sources={
+            "alpine 3.16": {
+                "musl": {"CVE-2022-1": {"FixedVersion": "1.2.3-r1"}},
+                "busybox": {
+                    "CVE-2022-2": {"FixedVersion": "1.35.0-r18"},
+                    "CVE-2022-3": {"FixedVersion": "1.35.0-r19"}},
+            },
+            "pip::Python": {
+                "django": {"GHSA-aaaa": {
+                    "VulnerableVersions": ["<4.0.2"],
+                    "PatchedVersions": [">=4.0.2"]}},
+            },
+        },
+        details={
+            "CVE-2022-1": {"Title": "musl bug", "Severity": "HIGH"},
+            "CVE-2022-2": {"Title": "bb one", "Severity": "LOW"},
+            "CVE-2022-3": {"Title": "bb two", "Severity": "MEDIUM"},
+            "GHSA-aaaa": {"Title": "django bug",
+                          "Severity": "CRITICAL"},
+        })
+    return path
+
+
+class TestReader:
+    def test_top_level_buckets(self, tiny_db):
+        with BoltDB(tiny_db) as db:
+            names = sorted(k.decode() for k, _ in db.buckets())
+        assert names == ["alpine 3.16", "pip::Python",
+                         "vulnerability"]
+
+    def test_nested_inline_buckets(self, tiny_db):
+        with BoltDB(tiny_db) as db:
+            alpine = db.bucket(b"alpine 3.16")
+            pkgs = dict(alpine.buckets())
+            assert sorted(p.decode() for p in pkgs) == \
+                ["busybox", "musl"]
+            musl = pkgs[b"musl"]
+            val = musl.get(b"CVE-2022-1")
+            assert json.loads(val) == {"FixedVersion": "1.2.3-r1"}
+
+    def test_flat_bucket_items(self, tiny_db):
+        with BoltDB(tiny_db) as db:
+            detail = db.bucket(b"vulnerability")
+            items = {k.decode(): json.loads(v)
+                     for k, v in detail.items()}
+        assert items["GHSA-aaaa"]["Severity"] == "CRITICAL"
+        assert len(items) == 4
+
+    def test_branch_page_descent(self, tmp_path):
+        w = bw.Writer()
+        leaf1 = w.leaf_page([(0, b"a", b"1"), (0, b"b", b"2")])
+        leaf2 = w.leaf_page([(0, b"c", b"3"), (0, b"d", b"4")])
+        branch = w.branch_page([(b"a", leaf1), (b"c", leaf2)])
+        root = w.leaf_page([(bw.LEAF_FLAG_BUCKET, b"data",
+                             w.bucket_value(branch))])
+        path = str(tmp_path / "branch.db")
+        w.write(path, root)
+        with BoltDB(path) as db:
+            items = dict(db.bucket(b"data").items())
+        assert items == {b"a": b"1", b"b": b"2",
+                         b"c": b"3", b"d": b"4"}
+
+    def test_overflow_value(self, tmp_path):
+        big = b"x" * (3 * bw.PAGE_SIZE)
+        w = bw.Writer()
+        leaf = w.leaf_page([(0, b"big", big), (0, b"small", b"s")])
+        root = w.leaf_page([(bw.LEAF_FLAG_BUCKET, b"data",
+                             w.bucket_value(leaf))])
+        path = str(tmp_path / "overflow.db")
+        w.write(path, root)
+        with BoltDB(path) as db:
+            items = dict(db.bucket(b"data").items())
+        assert items[b"big"] == big
+        assert items[b"small"] == b"s"
+
+    def test_not_a_boltdb(self, tmp_path):
+        p = tmp_path / "x.db"
+        p.write_bytes(b"hello world" * 1000)
+        with pytest.raises(CorruptDB):
+            BoltDB(str(p))
+
+    def test_missing_bucket(self, tiny_db):
+        with BoltDB(tiny_db) as db:
+            assert db.bucket(b"nope") is None
+
+
+class TestIngestion:
+    def test_load_trivy_db(self, tiny_db):
+        store, n_adv, n_detail = load_trivy_db(tiny_db)
+        assert (n_adv, n_detail) == (4, 4)
+        advs = store.get("alpine 3.16", "busybox")
+        assert sorted(a.vulnerability_id for a in advs) == \
+            ["CVE-2022-2", "CVE-2022-3"]
+        advs = store.get_advisories("pip::", "django")
+        assert advs[0].vulnerability_id == "GHSA-aaaa"
+        detail = store.get_vulnerability("CVE-2022-1")
+        assert detail.severity == "HIGH"
+
+    def test_end_to_end_scan(self, tiny_db, tmp_path):
+        """boltdb → store → compiled DB → detection."""
+        from trivy_tpu.db import CompiledDB
+        from trivy_tpu.detect.batch import dispatch_jobs
+        from trivy_tpu.scan.local import LocalScanner, ScanTarget
+        store, _, _ = load_trivy_db(tiny_db)
+        cdb = CompiledDB.compile(store)
+        assert cdb.stats["rows"] == 4
+
+    def test_cli_db_build_from_boltdb(self, tiny_db, tmp_path):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        out_prefix = str(tmp_path / "compiled")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(["db", "build", "--from-boltdb", tiny_db,
+                         "--output", out_prefix])
+        assert code == 0
+        from trivy_tpu.db import CompiledDB
+        cdb = CompiledDB.load(out_prefix)
+        assert cdb.stats["rows"] == 4

@@ -28,7 +28,12 @@ def decode_to_blob(data: bytes):
     sha256 of the canonical blob JSON, so identical SBOMs dedup in the
     cache. Shared by SBOMArtifact and BatchScanRunner.scan_boms.
     Raises ValueError on unknown format."""
-    fmt, decoded = sbom_mod.sniff_and_decode(data)
+    try:
+        fmt, decoded = sbom_mod.sniff_and_decode(data)
+    except (KeyError, AttributeError, TypeError) as e:
+        # malformed-but-sniffable documents: surface as a decode
+        # error, not a crash, for every caller
+        raise ValueError(f"SBOM decode error: {e!r}")
     blob = BlobInfo(
         os=decoded.os,
         package_infos=decoded.packages,

@@ -101,8 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
     build = dbsub.add_parser(
         "build", help="compile fixtures into persistent TPU-resident "
         "advisory tables")
-    build.add_argument("--from-fixtures", required=True,
+    build.add_argument("--from-fixtures", default="",
                        help="comma-separated advisory fixture YAMLs")
+    build.add_argument("--from-boltdb", default="",
+                       help="trivy-db BoltDB file (the reference's "
+                       "native advisory store format)")
     build.add_argument("--output", "-o", required=True,
                        help="output path prefix (.npz/.pkl)")
 
@@ -130,14 +133,36 @@ def run_db(args) -> int:
     if args.db_command != "build":
         print("error: unknown db subcommand", file=sys.stderr)
         return 2
-    from .db import CompiledDB
-    store = load_fixtures(
-        [p for p in args.from_fixtures.split(",") if p])
+    if not args.from_fixtures and not args.from_boltdb:
+        print("error: --from-fixtures or --from-boltdb required",
+              file=sys.stderr)
+        return 2
+    import time
+    from .db import AdvisoryStore, CompiledDB
+    store = AdvisoryStore()
+    if args.from_fixtures:
+        load_fixtures(
+            [p for p in args.from_fixtures.split(",") if p], store)
+    if args.from_boltdb:
+        from .db.boltdb import CorruptDB, load_trivy_db
+        t0 = time.perf_counter()
+        try:
+            _, n_adv, n_detail = load_trivy_db(args.from_boltdb,
+                                               store)
+        except (OSError, CorruptDB) as e:
+            print(f"error: failed to read boltdb: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"ingested {n_adv} advisories + {n_detail} detail "
+              f"records from {args.from_boltdb} "
+              f"in {time.perf_counter() - t0:.2f}s")
+    t0 = time.perf_counter()
     cdb = CompiledDB.compile(store)
+    compile_s = time.perf_counter() - t0
     cdb.save(args.output)
     print(f"compiled {cdb.stats['rows']} advisories "
-          f"({cdb.stats['host_fallback_rows']} host-fallback) "
-          f"-> {args.output}.npz/.pkl")
+          f"({cdb.stats['host_fallback_rows']} host-fallback, "
+          f"{compile_s:.2f}s) -> {args.output}.npz/.pkl")
     return 0
 
 

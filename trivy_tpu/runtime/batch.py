@@ -186,10 +186,9 @@ class BatchScanRunner:
         for i, (name, data) in enumerate(boms):
             try:
                 atype, decoded, blob, blob_id = decode_to_blob(data)
-            except (ValueError, KeyError, AttributeError,
-                    TypeError) as e:
-                # malformed-but-sniffable documents must fail their
-                # own slot, never the fleet
+            except ValueError as e:
+                # a malformed document fails its own slot, never the
+                # fleet (decode_to_blob normalizes decode crashes)
                 failures[i] = BatchScanResult(name=name, error=str(e))
                 continue
             self.cache.put_blob(blob_id, blob)
