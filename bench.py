@@ -299,6 +299,11 @@ def make_sbom_store(rng):
                 store.put_advisory(
                     bucket, name_tpl.format(n=f"{eco}-lib-{i}"),
                     vid, _ghsa_constraint(rng, fixed))
+                store.put_vulnerability(vid, {
+                    "Title": f"{eco}-lib-{i} advisory {a}",
+                    "Severity": ("LOW", "MEDIUM", "HIGH",
+                                 "CRITICAL")[int(rng.integers(0, 4))],
+                })
                 n_adv += 1
     return store, n_adv
 
@@ -427,20 +432,20 @@ def bench_sboms() -> dict:
     # BoltDB format: fixture writer → production reader, so the
     # ingest path is measured at full scale
     from trivy_tpu.db.boltwriter import write_trivy_db
-    sources: dict = {}
-    for bucket, pkgs in store.buckets.items():
-        if bucket == "vulnerability":
-            continue
-        sources[bucket] = {p: dict(vulns)
-                           for p, vulns in pkgs.items()}
+    sources = {bucket: {p: dict(vulns)
+                        for p, vulns in pkgs.items()}
+               for bucket, pkgs in store.buckets.items()}
     with tempfile.TemporaryDirectory() as tmp:
         bolt_path = f"{tmp}/trivy.db"
-        write_trivy_db(bolt_path, sources, {})
+        write_trivy_db(bolt_path, sources,
+                       dict(store.vulnerabilities))
         t0 = time.perf_counter()
-        ingested, n_ing, _ = load_trivy_db(bolt_path)
+        ingested, n_ing, n_detail = load_trivy_db(bolt_path)
         boltdb_ingest_s = time.perf_counter() - t0
-    assert n_ing == n_adv, f"boltdb round-trip lost rows: " \
-        f"{n_ing} != {n_adv}"
+    assert n_ing == n_adv and \
+        n_detail == len(store.vulnerabilities), \
+        f"boltdb round-trip lost rows: {n_ing}/{n_adv} advisories, " \
+        f"{n_detail}/{len(store.vulnerabilities)} details"
     store = ingested
 
     t0 = time.perf_counter()

@@ -113,14 +113,37 @@ class TestIngestion:
         detail = store.get_vulnerability("CVE-2022-1")
         assert detail.severity == "HIGH"
 
-    def test_end_to_end_scan(self, tiny_db, tmp_path):
-        """boltdb → store → compiled DB → detection."""
+    def test_end_to_end_scan(self, tiny_db):
+        """boltdb → store → compiled DB → actual detection."""
+        from trivy_tpu.artifact.cache import MemoryCache
         from trivy_tpu.db import CompiledDB
-        from trivy_tpu.detect.batch import dispatch_jobs
         from trivy_tpu.scan.local import LocalScanner, ScanTarget
+        from trivy_tpu.types import ScanOptions
+        from trivy_tpu.types.artifact import (OS, Application,
+                                              BlobInfo, Package,
+                                              PackageInfo)
         store, _, _ = load_trivy_db(tiny_db)
         cdb = CompiledDB.compile(store)
         assert cdb.stats["rows"] == 4
+        cache = MemoryCache()
+        cache.put_blob("sha256:b1", BlobInfo(
+            os=OS(family="alpine", name="3.16.0"),
+            package_infos=[PackageInfo(packages=[
+                Package(name="musl", version="1.2.2", release="r7",
+                        src_name="musl", src_version="1.2.2",
+                        src_release="r7")])],
+            applications=[Application(type="pip", libraries=[
+                Package(name="django", version="4.0.1")])]))
+        results, _ = LocalScanner(cache, cdb).scan(
+            ScanTarget(name="t", artifact_id="a",
+                       blob_ids=["sha256:b1"]),
+            ScanOptions(security_checks=["vuln"], backend="cpu"))
+        ids = sorted(v.vulnerability_id for r in results
+                     for v in r.vulnerabilities)
+        assert ids == ["CVE-2022-1", "GHSA-aaaa"]
+        sev = {v.vulnerability_id: v.severity for r in results
+               for v in r.vulnerabilities}
+        assert sev["GHSA-aaaa"] == "CRITICAL"
 
     def test_cli_db_build_from_boltdb(self, tiny_db, tmp_path):
         import contextlib

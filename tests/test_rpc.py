@@ -1,0 +1,168 @@
+"""Client/server mode tests (mirrors
+integration/client_server_test.go:41 — thin client + stateful server,
+token auth, DB hot-swap mid-stream)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.db import AdvisoryStore, CompiledDB
+from trivy_tpu.rpc.client import RemoteCache, RemoteScanner, RPCError
+from trivy_tpu.rpc.server import DBWorker, ScanServer, serve
+from trivy_tpu.types import ScanOptions
+from trivy_tpu.types.artifact import OS, BlobInfo, Package, PackageInfo
+from trivy_tpu.scan.local import ScanTarget
+
+
+def _store(fixed="1.1.20-r5"):
+    store = AdvisoryStore()
+    store.put_advisory("alpine 3.9", "musl", "CVE-2019-14697",
+                       {"FixedVersion": fixed})
+    store.put_vulnerability("CVE-2019-14697",
+                            {"Title": "musl bug",
+                             "Severity": "CRITICAL"})
+    return store
+
+
+def _blob() -> BlobInfo:
+    return BlobInfo(
+        os=OS(family="alpine", name="3.9.4"),
+        package_infos=[PackageInfo(packages=[
+            Package(name="musl", version="1.1.20", release="r4",
+                    src_name="musl", src_version="1.1.20",
+                    src_release="r4")])])
+
+
+@pytest.fixture()
+def server():
+    srv = ScanServer(store=_store(), token="s3cret")
+    httpd, _ = serve(port=0, server=srv)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield srv, url
+    httpd.shutdown()
+
+
+def _push_and_scan(url, token="s3cret", backend="cpu"):
+    cache = RemoteCache(url, token=token, max_retries=2,
+                        backoff_base_s=0.01)
+    missing_artifact, missing = cache.missing_blobs(
+        "sha256:art1", ["sha256:blob1"])
+    assert missing_artifact and missing == ["sha256:blob1"]
+    cache.put_blob("sha256:blob1", _blob())
+    scanner = RemoteScanner(url, token=token, max_retries=2,
+                            backoff_base_s=0.01)
+    return scanner.scan(
+        ScanTarget(name="img:1", artifact_id="sha256:art1",
+                   blob_ids=["sha256:blob1"]),
+        ScanOptions(security_checks=["vuln"], backend=backend))
+
+
+class TestClientServer:
+    def test_scan_over_the_wire(self, server):
+        _, url = server
+        results, os_found = _push_and_scan(url)
+        assert os_found.family == "alpine"
+        vulns = [v for r in results for v in r.vulnerabilities]
+        assert [v.vulnerability_id for v in vulns] == \
+            ["CVE-2019-14697"]
+        assert vulns[0].severity == "CRITICAL"
+        assert vulns[0].fixed_version == "1.1.20-r5"
+
+    def test_blob_dedup_second_client(self, server):
+        _, url = server
+        _push_and_scan(url)
+        cache = RemoteCache(url, token="s3cret", max_retries=2)
+        _, missing = cache.missing_blobs("sha256:art1",
+                                         ["sha256:blob1"])
+        assert missing == []     # server-side cache remembers
+
+    def test_bad_token_unauthenticated(self, server):
+        _, url = server
+        with pytest.raises(RPCError) as e:
+            _push_and_scan(url, token="wrong")
+        assert e.value.code == 401
+
+    def test_unknown_route_bad_route(self, server):
+        _, url = server
+        c = RemoteCache(url, token="s3cret", max_retries=1)
+        with pytest.raises(RPCError) as e:
+            c.call("/twirp/trivy.cache.v1.Cache/Nope", {})
+        assert e.value.code == 404
+
+    def test_retry_then_fail_when_unreachable(self):
+        c = RemoteCache("http://127.0.0.1:1", max_retries=3,
+                        backoff_base_s=0.01)
+        with pytest.raises(RPCError) as e:
+            c.missing_blobs("a", ["b"])
+        assert e.value.code == "unavailable"
+
+    def test_db_hot_swap_mid_stream(self, server):
+        """Mirrors the reference's hourly-update gating: scans before
+        the swap see the old DB, scans after see the new one."""
+        srv, url = server
+        results, _ = _push_and_scan(url)
+        assert [v.fixed_version for r in results
+                for v in r.vulnerabilities] == ["1.1.20-r5"]
+        srv.store.swap(CompiledDB.compile(_store(fixed="1.1.21-r0")))
+        scanner = RemoteScanner(url, token="s3cret", max_retries=2)
+        results, _ = scanner.scan(
+            ScanTarget(name="img:1", artifact_id="sha256:art1",
+                       blob_ids=["sha256:blob1"]),
+            ScanOptions(security_checks=["vuln"], backend="cpu"))
+        assert [v.fixed_version for r in results
+                for v in r.vulnerabilities] == ["1.1.21-r0"]
+
+    def test_healthz(self, server):
+        import urllib.request
+        _, url = server
+        with urllib.request.urlopen(url + "/healthz") as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+
+
+class TestDBWorker:
+    def test_watches_and_swaps(self, tmp_path, server):
+        srv, url = server
+        prefix = str(tmp_path / "db")
+        CompiledDB.compile(_store()).save(prefix)
+        worker = DBWorker(srv.store, prefix, interval_s=9999)
+        assert not worker.check_once()      # unchanged
+        import os
+        import time
+        CompiledDB.compile(_store(fixed="9.9.9-r9")).save(prefix)
+        os.utime(prefix + ".npz",
+                 (time.time() + 5, time.time() + 5))
+        assert worker.check_once()
+        results, _ = _push_and_scan(url)
+        assert [v.fixed_version for r in results
+                for v in r.vulnerabilities] == ["9.9.9-r9"]
+
+
+class TestCLIClientServer:
+    def test_image_scan_via_server(self, tmp_path, server):
+        """Full CLI: client inspects the tarball locally, pushes
+        blobs, server detects (client_server_test.go:41 shape)."""
+        from tests.test_e2e_image import make_image_tar
+        _, url = server
+        img = make_image_tar(tmp_path, [{
+            "etc/alpine-release": b"3.9.4\n",
+            "lib/apk/db/installed":
+                b"P:musl\nV:1.1.20-r4\no:musl\nL:MIT\n\n",
+        }])
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        out_file = tmp_path / "r.json"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(["image", "--input", img,
+                         "--server", url, "--token", "s3cret",
+                         "--format", "json",
+                         "--output", str(out_file),
+                         "--backend", "cpu",
+                         "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        ids = [v["VulnerabilityID"] for r in report["Results"]
+               for v in r.get("Vulnerabilities", [])]
+        assert ids == ["CVE-2019-14697"]

@@ -128,106 +128,8 @@ class FSCache(MemoryCache):
             return None
 
 
-def _blob_from_dict(d: dict) -> BlobInfo:
-    """JSON → BlobInfo (inverse of asdict_omitempty for the fields the
-    applier consumes)."""
-    from ..types import (OS, Application, ConfigFile, Package,
-                         PackageInfo, Repository, Secret,
-                         SecretFinding)
-    from ..types.common import Code, Layer, Line
-
-    def layer(x):
-        return Layer(digest=x.get("Digest", ""),
-                     diff_id=x.get("DiffID", "")) if x else Layer()
-
-    def pkg(x):
-        return Package(
-            id=x.get("ID", ""), name=x.get("Name", ""),
-            version=x.get("Version", ""), release=x.get("Release", ""),
-            epoch=x.get("Epoch", 0), arch=x.get("Arch", ""),
-            src_name=x.get("SrcName", ""),
-            src_version=x.get("SrcVersion", ""),
-            src_release=x.get("SrcRelease", ""),
-            src_epoch=x.get("SrcEpoch", 0),
-            licenses=x.get("Licenses") or [],
-            modularity_label=x.get("Modularitylabel", ""),
-            indirect=x.get("Indirect", False),
-            depends_on=x.get("DependsOn") or [],
-            layer=layer(x.get("Layer")),
-            file_path=x.get("FilePath", ""),
-            ref=x.get("Ref", ""),
-        )
-
-    def finding(x):
-        code = Code(lines=[
-            Line(number=ln.get("Number", 0),
-                 content=ln.get("Content", ""),
-                 is_cause=ln.get("IsCause", False),
-                 annotation=ln.get("Annotation", ""),
-                 truncated=ln.get("Truncated", False),
-                 highlighted=ln.get("Highlighted", ""),
-                 first_cause=ln.get("FirstCause", False),
-                 last_cause=ln.get("LastCause", False))
-            for ln in (x.get("Code") or {}).get("Lines") or []])
-        return SecretFinding(
-            rule_id=x.get("RuleID", ""),
-            category=x.get("Category", ""),
-            severity=x.get("Severity", ""),
-            title=x.get("Title", ""),
-            start_line=x.get("StartLine", 0),
-            end_line=x.get("EndLine", 0),
-            code=code, match=x.get("Match", ""),
-            layer=layer(x.get("Layer")))
-
-    os_ = None
-    if d.get("OS"):
-        os_ = OS(family=d["OS"].get("Family", ""),
-                 name=d["OS"].get("Name", ""),
-                 eosl=d["OS"].get("Eosl", False),
-                 extended=d["OS"].get("Extended", False))
-    repo = None
-    if d.get("Repository"):
-        repo = Repository(family=d["Repository"].get("Family", ""),
-                          release=d["Repository"].get("Release", ""))
-    return BlobInfo(
-        schema_version=d.get("SchemaVersion", SCHEMA_VERSION),
-        digest=d.get("Digest", ""),
-        diff_id=d.get("DiffID", ""),
-        os=os_,
-        repository=repo,
-        package_infos=[
-            PackageInfo(file_path=pi.get("FilePath", ""),
-                        packages=[pkg(p) for p in
-                                  pi.get("Packages") or []])
-            for pi in d.get("PackageInfos") or []],
-        applications=[
-            Application(type=ap.get("Type", ""),
-                        file_path=ap.get("FilePath", ""),
-                        libraries=[pkg(p) for p in
-                                   ap.get("Libraries") or []])
-            for ap in d.get("Applications") or []],
-        config_files=[
-            ConfigFile(type=cf.get("Type", ""),
-                       file_path=cf.get("FilePath", ""),
-                       content=(cf.get("Content") or "").encode())
-            for cf in d.get("ConfigFiles") or []],
-        secrets=[
-            Secret(file_path=s.get("FilePath", ""),
-                   findings=[finding(f) for f in
-                             s.get("Findings") or []])
-            for s in d.get("Secrets") or []],
-        opaque_dirs=d.get("OpaqueDirs") or [],
-        whiteout_files=d.get("WhiteoutFiles") or [],
-        system_files=d.get("SystemFiles") or [],
-    )
-
-
-def _artifact_from_dict(d: dict) -> ArtifactInfo:
-    return ArtifactInfo(
-        schema_version=d.get("SchemaVersion", SCHEMA_VERSION),
-        architecture=d.get("Architecture", ""),
-        created=d.get("Created", ""),
-        docker_version=d.get("DockerVersion", ""),
-        os=d.get("OS", ""),
-        history_packages=d.get("HistoryPackages") or [],
-    )
+# deserialization lives with the types (shared with the RPC wire)
+from ..types.convert import artifact_info_from_dict as \
+    _artifact_from_dict  # noqa: E402
+from ..types.convert import blob_info_from_dict as \
+    _blob_from_dict  # noqa: E402

@@ -73,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(path prefix from 'trivy-tpu db build')")
         sp.add_argument("--secret-config", default="trivy-secret.yaml")
         sp.add_argument("--no-cache", action="store_true")
+        sp.add_argument("--server", default="",
+                        help="server URL for client/server mode "
+                        "(detection runs remotely; no local DB)")
+        sp.add_argument("--token", dest="auth_token", default="",
+                        help="server auth token")
+        sp.add_argument("--token-header", default="Trivy-Token")
+        sp.add_argument("--custom-headers", default="",
+                        help="comma-separated k=v headers sent to "
+                        "the server")
 
     img = sub.add_parser("image", help="scan a container image "
                          "(tarball or OCI layout)")
@@ -109,6 +118,21 @@ def build_parser() -> argparse.ArgumentParser:
     build.add_argument("--output", "-o", required=True,
                        help="output path prefix (.npz/.pkl)")
 
+    srv = sub.add_parser("server", help="run in server mode "
+                         "(owns cache + advisory DB + TPU dispatch)")
+    srv.add_argument("--listen", default="127.0.0.1:4954")
+    srv.add_argument("--token", dest="auth_token", default="")
+    srv.add_argument("--token-header", default="Trivy-Token")
+    srv.add_argument("--cache-dir",
+                     default=os.path.join(
+                         os.path.expanduser("~"), ".cache",
+                         "trivy-tpu"))
+    srv.add_argument("--db-fixtures", default="")
+    srv.add_argument("--compiled-db", default="",
+                     help="compiled advisory DB path prefix; the "
+                     "server hot-swaps when the file changes")
+    srv.add_argument("--db-watch-interval", type=float, default=60.0)
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -126,7 +150,39 @@ def main(argv=None) -> int:
         return run_sbom(args)
     if args.command == "db":
         return run_db(args)
+    if args.command == "server":
+        return run_server(args)
     return 2
+
+
+def run_server(args) -> int:
+    from .rpc.server import ScanServer, serve_forever
+    host, _, port = args.listen.rpartition(":")
+    if not port.isdigit():
+        print(f"error: --listen needs host:port, got "
+              f"{args.listen!r}", file=sys.stderr)
+        return 2
+    try:
+        store = _store(args)
+    except (OSError, ValueError) as e:
+        # a missing compiled DB is fine — the watch worker swaps it
+        # in when `db build` produces it
+        if args.compiled_db:
+            print(f"advisory db not loadable yet ({e}); waiting for "
+                  f"{args.compiled_db}.npz", file=sys.stderr)
+            store = AdvisoryStore()
+        else:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    server = ScanServer(store=store,
+                        cache_dir=args.cache_dir,
+                        token=args.auth_token,
+                        token_header=args.token_header)
+    print(f"trivy-tpu server listening on {args.listen}")
+    serve_forever(host or "127.0.0.1", int(port), server,
+                  db_watch_prefix=args.compiled_db,
+                  db_watch_interval_s=args.db_watch_interval)
+    return 0
 
 
 def run_db(args) -> int:
@@ -248,11 +304,43 @@ def _finish(args, report: Report) -> int:
     return 0
 
 
+def _custom_headers(args) -> dict:
+    out = {}
+    for pair in (getattr(args, "custom_headers", "") or "").split(","):
+        if "=" in pair:
+            k, _, v = pair.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
 def _cache(args):
+    if getattr(args, "server", ""):
+        # client/server split: blobs push to the server's cache
+        # (ref run.go:296-299 NopCache(RemoteCache))
+        from .rpc.client import RemoteCache
+        return RemoteCache(args.server, token=args.auth_token,
+                           token_header=args.token_header,
+                           custom_headers=_custom_headers(args))
     from .artifact.cache import MemoryCache
     if args.no_cache:
         return MemoryCache()
     return FSCache(args.cache_dir)
+
+
+def _rpc_error():
+    from .rpc.client import RPCError
+    return RPCError
+
+
+def _scanner(args, cache):
+    """Local or remote scan driver — the client needs no DB when a
+    server is set (ref run.go:269-271 initDB skipped)."""
+    if getattr(args, "server", ""):
+        from .rpc.client import RemoteScanner
+        return RemoteScanner(args.server, token=args.auth_token,
+                             token_header=args.token_header,
+                             custom_headers=_custom_headers(args))
+    return LocalScanner(cache, _store(args))
 
 
 def run_image(args) -> int:
@@ -270,13 +358,16 @@ def run_image(args) -> int:
     cache = _cache(args)
     artifact = ImageArtifact(image, cache,
                              option=_artifact_option(args))
-    ref = artifact.inspect()
-
-    scanner = LocalScanner(cache, _store(args))
-    results, os_found = scanner.scan(
-        ScanTarget(name=ref.name, artifact_id=ref.id,
-                   blob_ids=ref.blob_ids),
-        _scan_options(args))
+    try:
+        ref = artifact.inspect()
+        scanner = _scanner(args, cache)
+        results, os_found = scanner.scan(
+            ScanTarget(name=ref.name, artifact_id=ref.id,
+                       blob_ids=ref.blob_ids),
+            _scan_options(args))
+    except _rpc_error() as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
     report = Report(
         artifact_name=ref.name,
@@ -310,13 +401,16 @@ def run_sbom(args) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    scanner = LocalScanner(cache, _store(args))
     options = _scan_options(args)
     options.security_checks = ["vuln"]
-    results, os_found = scanner.scan(
-        ScanTarget(name=ref.name, artifact_id=ref.id,
-                   blob_ids=ref.blob_ids),
-        options)
+    try:
+        results, os_found = _scanner(args, cache).scan(
+            ScanTarget(name=ref.name, artifact_id=ref.id,
+                       blob_ids=ref.blob_ids),
+            options)
+    except _rpc_error() as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     report = Report(
         artifact_name=args.target,
         artifact_type=ref.type,
@@ -335,12 +429,15 @@ def run_fs(args) -> int:
     cache = _cache(args)
     artifact = LocalFSArtifact(args.target, cache,
                                option=_artifact_option(args))
-    ref = artifact.inspect()
-    scanner = LocalScanner(cache, _store(args))
-    results, os_found = scanner.scan(
-        ScanTarget(name=ref.name, artifact_id=ref.id,
-                   blob_ids=ref.blob_ids),
-        _scan_options(args))
+    try:
+        ref = artifact.inspect()
+        results, os_found = _scanner(args, cache).scan(
+            ScanTarget(name=ref.name, artifact_id=ref.id,
+                       blob_ids=ref.blob_ids),
+            _scan_options(args))
+    except _rpc_error() as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     report = Report(
         artifact_name=args.target,
         artifact_type="filesystem",

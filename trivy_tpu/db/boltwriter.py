@@ -11,18 +11,13 @@ from __future__ import annotations
 
 import struct
 
-PAGE_SIZE = 4096
-PAGE_HEADER = 16
-LEAF_ELEM = 16
-BRANCH_ELEM = 16
-BUCKET_HEADER = 16
+# the on-disk layout is defined once, by the reader
+from .boltdb import (BRANCH_ELEM, BUCKET_HEADER, FLAG_BRANCH,
+                     FLAG_FREELIST, FLAG_LEAF, FLAG_META,
+                     LEAF_ELEM, LEAF_FLAG_BUCKET, MAGIC,
+                     PAGE_HEADER)
 
-FLAG_BRANCH = 0x01
-FLAG_LEAF = 0x02
-FLAG_META = 0x04
-FLAG_FREELIST = 0x10
-LEAF_FLAG_BUCKET = 0x01
-MAGIC = 0xED0CDAED
+PAGE_SIZE = 4096
 
 
 def _page_header(pgid, flags, count, overflow=0) -> bytes:
@@ -49,18 +44,11 @@ def _leaf_page_body(items, pgid=0) -> bytes:
 
 
 def inline_bucket_value(items) -> bytes:
-    """Bucket value with root=0 and an embedded leaf page."""
-    body = _page_header(0, FLAG_LEAF, len(items))
-    elems = b""
-    data = b""
-    data_start = PAGE_HEADER + len(items) * LEAF_ELEM
-    for i, (lf, key, val) in enumerate(items):
-        elem_off = PAGE_HEADER + i * LEAF_ELEM
-        pos = data_start + len(data) - elem_off
-        elems += struct.pack("<IIII", lf, pos, len(key), len(val))
-        data += key + val
-    return struct.pack("<QQ", 0, 0) + body[:PAGE_HEADER] + \
-        elems + data
+    """Bucket value with root=0 and an embedded leaf page (same
+    element packing as a real leaf page, unpadded)."""
+    total = PAGE_HEADER + sum(LEAF_ELEM + len(k) + len(v)
+                              for _, k, v in items)
+    return struct.pack("<QQ", 0, 0) + _leaf_page_body(items)[:total]
 
 
 class Writer:

@@ -1,0 +1,142 @@
+"""RPC client (reference: pkg/rpc/client/client.go + retry.go).
+
+``trivy-tpu image --server URL``: the client inspects the artifact
+locally (analyzers + secret scanning run client-side), pushes
+BlobInfos to the server's cache, and asks the server to run
+detection against its DB — the client needs no advisory store at all
+(run.go:269-271). Transient failures retry with exponential backoff
+×10, like retry.go:16-41 does on twirp.Unavailable.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..types import Result
+from ..types.convert import os_from_dict, result_from_dict
+from ..utils import get_logger
+from .server import CACHE_PREFIX, DEFAULT_TOKEN_HEADER, SCANNER_PREFIX
+
+log = get_logger("rpc.client")
+
+MAX_RETRIES = 10
+BACKOFF_BASE_S = 0.2
+
+
+class RPCError(RuntimeError):
+    def __init__(self, code, msg):
+        super().__init__(f"rpc error {code}: {msg}")
+        self.code = code
+
+
+class _Client:
+    def __init__(self, base_url: str, token: str = "",
+                 token_header: str = DEFAULT_TOKEN_HEADER,
+                 custom_headers: Optional[dict] = None,
+                 max_retries: int = MAX_RETRIES,
+                 backoff_base_s: float = BACKOFF_BASE_S,
+                 timeout_s: float = 300.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.token_header = token_header
+        self.custom_headers = custom_headers or {}
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.timeout_s = timeout_s
+
+    def call(self, path: str, body: dict) -> dict:
+        """POST with exponential-backoff retry on transient errors
+        only (connection refused / 5xx — retry.go retries only
+        twirp.Unavailable)."""
+        data = json.dumps(body).encode()
+        last_err = None
+        for attempt in range(self.max_retries):
+            if attempt:
+                time.sleep(self.backoff_base_s * (2 ** (attempt - 1)))
+            req = urllib.request.Request(
+                self.base_url + path, data=data, method="POST",
+                headers={"Content-Type": "application/json",
+                         **self.custom_headers})
+            if self.token:
+                req.add_header(self.token_header, self.token)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode("utf-8", "replace")
+                if e.code >= 500:           # transient: retry
+                    last_err = RPCError(e.code, detail)
+                    log.debug("retrying %s after %d: %s",
+                              path, e.code, detail)
+                    continue
+                raise RPCError(e.code, detail)
+            except (urllib.error.URLError, OSError,
+                    ConnectionError) as e:
+                last_err = RPCError("unavailable", str(e))
+                log.debug("retrying %s after %s", path, e)
+                continue
+        raise last_err
+
+
+class RemoteCache(_Client):
+    """Cache service client — satisfies the local cache interface the
+    artifact layer uses, so inspection code is oblivious to the wire
+    (reference: NopCache(RemoteCache), run.go:296-299)."""
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list) -> tuple:
+        out = self.call(CACHE_PREFIX + "MissingBlobs",
+                        {"artifact_id": artifact_id,
+                         "blob_ids": list(blob_ids)})
+        return (out.get("missing_artifact", False),
+                out.get("missing_blob_ids") or [])
+
+    def put_artifact(self, artifact_id: str, info) -> None:
+        self.call(CACHE_PREFIX + "PutArtifact",
+                  {"artifact_id": artifact_id,
+                   "artifact_info": info.to_dict()})
+
+    def put_blob(self, blob_id: str, blob) -> None:
+        self.call(CACHE_PREFIX + "PutBlob",
+                  {"diff_id": blob_id,
+                   "blob_info": blob.to_dict()})
+
+    def delete_blobs(self, blob_ids: list) -> None:
+        self.call(CACHE_PREFIX + "DeleteBlobs",
+                  {"blob_ids": list(blob_ids)})
+
+    def get_blob(self, blob_id: str):
+        """The wire cache is write-only from the client side (the
+        server scans its own copy)."""
+        return None
+
+    def get_artifact(self, artifact_id: str):
+        return None
+
+
+class RemoteScanner(_Client):
+    """Scanner service client — the remote analog of
+    LocalScanner.scan (reference: pkg/rpc/client client.go:64-94)."""
+
+    def scan(self, target, options) -> tuple:
+        """``target`` is a ScanTarget — same call shape as
+        LocalScanner.scan, so the CLI swaps drivers freely
+        (scanner.Driver in the reference)."""
+        out = self.call(SCANNER_PREFIX + "Scan", {
+            "target": target.name,
+            "artifact_id": target.artifact_id,
+            "blob_ids": list(target.blob_ids),
+            "options": {
+                "vuln_type": list(options.vuln_type),
+                "security_checks": list(options.security_checks),
+                "list_all_packages": options.list_all_packages,
+                "backend": getattr(options, "backend", "tpu"),
+            },
+        })
+        results = [result_from_dict(r)
+                   for r in out.get("results") or []]
+        return results, os_from_dict(out.get("os"))

@@ -1,0 +1,253 @@
+"""RPC server (reference: pkg/rpc/server/{listen.go,server.go}).
+
+``trivy-tpu server`` owns the blob cache, the advisory store (behind
+``SwappableStore`` so a rebuilt compiled DB hot-swaps between
+requests, listen.go:54-83's RW-waitgroup analog), and the TPU
+dispatch. Thin clients push BlobInfos over the Cache service and ask
+the Scanner service to scan — server.go:37-48 runs the same local
+scanner against the server-side cache, and so does this.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..artifact.cache import FSCache, MemoryCache
+from ..db import AdvisoryStore, CompiledDB
+from ..db.compiled import SwappableStore
+from ..scan.local import LocalScanner, ScanTarget
+from ..types import ScanOptions
+from ..types.convert import (artifact_info_from_dict,
+                             blob_info_from_dict)
+from ..utils import get_logger
+
+log = get_logger("rpc.server")
+
+SCANNER_PREFIX = "/twirp/trivy.scanner.v1.Scanner/"
+CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
+DEFAULT_TOKEN_HEADER = "Trivy-Token"
+
+
+class ScanServer:
+    """Request handlers + the swappable store. HTTP-framework-free so
+    tests can drive it directly."""
+
+    def __init__(self, store=None, cache=None,
+                 cache_dir: str = "", token: str = "",
+                 token_header: str = DEFAULT_TOKEN_HEADER):
+        if isinstance(store, SwappableStore):
+            self.store = store
+        else:
+            self.store = SwappableStore(store if store is not None
+                                        else AdvisoryStore())
+        if cache is None:
+            cache = FSCache(cache_dir) if cache_dir else MemoryCache()
+        self.cache = cache
+        self.token = token
+        self.token_header = token_header
+
+    # ---- Cache service (service.proto:10-15) ----
+
+    def put_artifact(self, body: dict) -> dict:
+        info = artifact_info_from_dict(body.get("artifact_info") or {})
+        self.cache.put_artifact(body.get("artifact_id", ""), info)
+        return {}
+
+    def put_blob(self, body: dict) -> dict:
+        blob = blob_info_from_dict(body.get("blob_info") or {})
+        self.cache.put_blob(body.get("diff_id", ""), blob)
+        return {}
+
+    def missing_blobs(self, body: dict) -> dict:
+        missing_artifact, missing = self.cache.missing_blobs(
+            body.get("artifact_id", ""), body.get("blob_ids") or [])
+        return {"missing_artifact": missing_artifact,
+                "missing_blob_ids": list(missing)}
+
+    def delete_blobs(self, body: dict) -> dict:
+        self.cache.delete_blobs(body.get("blob_ids") or [])
+        return {}
+
+    # ---- Scanner service (service.proto:8-29) ----
+
+    def scan(self, body: dict) -> dict:
+        opts = body.get("options") or {}
+        options = ScanOptions(
+            vuln_type=opts.get("vuln_type") or ["os", "library"],
+            security_checks=opts.get("security_checks") or ["vuln"],
+            list_all_packages=opts.get("list_all_packages", False),
+            backend=opts.get("backend", "tpu"),
+        )
+        # readers hold the store across the whole scan; swap waits
+        # for them to drain (SwappableStore), like the server's
+        # dbUpdateWg/requestWg pair
+        db = self.store.acquire()
+        try:
+            scanner = LocalScanner(self.cache, db)
+            results, os_found = scanner.scan(
+                ScanTarget(name=body.get("target", ""),
+                           artifact_id=body.get("artifact_id", ""),
+                           blob_ids=body.get("blob_ids") or []),
+                options)
+        finally:
+            self.store.release()
+        return {
+            "os": os_found.to_dict() if os_found else None,
+            "results": [r.to_dict() for r in results],
+        }
+
+    # ---- dispatch ----
+
+    ROUTES = {
+        CACHE_PREFIX + "PutArtifact": put_artifact,
+        CACHE_PREFIX + "PutBlob": put_blob,
+        CACHE_PREFIX + "MissingBlobs": missing_blobs,
+        CACHE_PREFIX + "DeleteBlobs": delete_blobs,
+        SCANNER_PREFIX + "Scan": scan,
+    }
+
+    def handle(self, path: str, body: dict) -> dict:
+        fn = self.ROUTES.get(path)
+        if fn is None:
+            raise LookupError(path)
+        return fn(self, body)
+
+
+class DBWorker(threading.Thread):
+    """Hot-swap worker (reference: hourly DB update, listen.go:54-83).
+
+    Watches a compiled-DB path prefix; when the file changes, loads
+    and stages the new tables, then swaps them in — in-flight scans
+    finish against the old tables, new scans see the new ones."""
+
+    def __init__(self, store: SwappableStore, db_prefix: str,
+                 interval_s: float = 60.0):
+        super().__init__(daemon=True)
+        self.store = store
+        self.db_prefix = db_prefix
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._mtime = self._current_mtime()
+
+    def _current_mtime(self) -> float:
+        try:
+            return os.path.getmtime(self.db_prefix + ".npz")
+        except OSError:
+            return 0.0
+
+    def check_once(self) -> bool:
+        mtime = self._current_mtime()
+        if mtime and mtime != self._mtime:
+            try:
+                cdb = CompiledDB.load(self.db_prefix)
+            except (OSError, ValueError) as e:
+                log.warning("db reload failed: %s", e)
+                return False
+            self._mtime = mtime
+            self.store.swap(cdb)
+            log.info("advisory db hot-swapped (%d rows)",
+                     cdb.stats.get("rows", 0))
+            return True
+        return False
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _make_handler(server: ScanServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            else:
+                self._reply(404, {"code": "bad_route",
+                                  "msg": self.path})
+
+        def do_POST(self):
+            if server.token:
+                import hmac
+                got = self.headers.get(server.token_header) or ""
+                if not hmac.compare_digest(got, server.token):
+                    self._reply(401, {"code": "unauthenticated",
+                                      "msg": "invalid token"})
+                    return
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"{}"
+            try:
+                body = json.loads(raw or b"{}")
+            except ValueError:
+                self._reply(400, {"code": "malformed",
+                                  "msg": "invalid json body"})
+                return
+            try:
+                out = server.handle(self.path, body)
+            except LookupError:
+                self._reply(404, {"code": "bad_route",
+                                  "msg": self.path})
+                return
+            except Exception as e:          # noqa: BLE001
+                log.warning("rpc %s failed: %r", self.path, e)
+                self._reply(500, {"code": "internal",
+                                  "msg": str(e)})
+                return
+            self._reply(200, out)
+
+    return Handler
+
+
+def serve(addr: str = "127.0.0.1", port: int = 4954,
+          server: Optional[ScanServer] = None,
+          db_watch_prefix: str = "",
+          db_watch_interval_s: float = 60.0) -> tuple:
+    """Start the HTTP server on a background thread. Returns
+    (httpd, worker|None); call ``httpd.shutdown()`` to stop."""
+    server = server or ScanServer()
+    httpd = ThreadingHTTPServer((addr, port), _make_handler(server))
+    thread = threading.Thread(target=httpd.serve_forever,
+                              daemon=True)
+    thread.start()
+    worker = None
+    if db_watch_prefix:
+        worker = DBWorker(server.store, db_watch_prefix,
+                          db_watch_interval_s)
+        worker.start()
+    log.info("listening on %s:%d", addr, httpd.server_address[1])
+    return httpd, worker
+
+
+def serve_forever(addr: str, port: int, server: ScanServer,
+                  db_watch_prefix: str = "",
+                  db_watch_interval_s: float = 60.0) -> None:
+    httpd, worker = serve(addr, port, server, db_watch_prefix,
+                          db_watch_interval_s)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if worker:
+            worker.stop()
+        httpd.shutdown()
