@@ -1,0 +1,318 @@
+"""Misconfiguration scanning tests (mirrors defsec's built-in check
+behavior + pkg/fanal/handler/misconf handling + the fs config scan
+integration path)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.misconf import scan_config_files
+from trivy_tpu.misconf.dockerfile import parse
+from trivy_tpu.types import ConfigFile
+
+BAD_DOCKERFILE = b"""FROM alpine:latest
+ADD app.py /app/
+EXPOSE 22 8080
+RUN adduser -D app
+USER root
+"""
+
+GOOD_DOCKERFILE = b"""FROM alpine:3.16
+COPY app.py /app/
+HEALTHCHECK CMD curl -f http://localhost/ || exit 1
+USER app
+"""
+
+BAD_K8S = b"""apiVersion: v1
+kind: Pod
+metadata:
+  name: web
+spec:
+  containers:
+    - name: app
+      image: nginx
+      securityContext:
+        privileged: true
+  volumes:
+    - name: sock
+      hostPath:
+        path: /var/run/docker.sock
+"""
+
+
+class TestDockerfileParser:
+    def test_stages_and_instructions(self):
+        stages = parse(BAD_DOCKERFILE)
+        assert len(stages) == 1
+        assert stages[0].base == "alpine:latest"
+        cmds = [i.cmd for i in stages[0].instructions]
+        assert cmds == ["ADD", "EXPOSE", "RUN", "USER"]
+        assert stages[0].instructions[-1].start_line == 5
+
+    def test_continuations_and_comments(self):
+        stages = parse(b"FROM a:1\n# comment\nRUN apk add \\\n"
+                       b"    curl \\\n    git\nUSER app\n")
+        run = stages[0].instructions[0]
+        assert run.value == "apk add curl git"
+        assert (run.start_line, run.end_line) == (3, 5)
+
+    def test_multi_stage(self):
+        stages = parse(b"FROM golang:1.19 AS build\nRUN make\n"
+                       b"FROM scratch\nCOPY --from=build /x /x\n")
+        assert [s.name for s in stages] == ["build", "scratch"]
+
+
+class TestDockerfilePolicies:
+    def _scan(self, content):
+        out = scan_config_files([ConfigFile(
+            type="dockerfile", file_path="Dockerfile",
+            content=content)])
+        assert len(out) == 1
+        return out[0]
+
+    def test_bad_dockerfile_failures(self):
+        mc = self._scan(BAD_DOCKERFILE)
+        assert mc.file_type == "dockerfile"
+        ids = {r.id for r in mc.failures}
+        assert ids == {"DS001", "DS002", "DS004", "DS005", "DS026"}
+        root = [r for r in mc.failures if r.id == "DS002"][0]
+        assert root.cause_metadata.start_line == 5
+        assert "root" in root.message
+
+    def test_good_dockerfile_passes(self):
+        mc = self._scan(GOOD_DOCKERFILE)
+        assert mc.failures == []
+        assert {r.id for r in mc.successes} == \
+            {"DS001", "DS002", "DS004", "DS005", "DS026"}
+
+    def test_missing_user(self):
+        mc = self._scan(b"FROM alpine:3.16\nRUN true\n")
+        msgs = {r.id: r.message for r in mc.failures}
+        assert "Specify at least 1 USER" in msgs["DS002"]
+
+    def test_add_allowed_for_archives_and_urls(self):
+        mc = self._scan(
+            b"FROM alpine:3.16\nADD rootfs.tar.gz /\n"
+            b"ADD https://example.com/x /x\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS005" not in {r.id for r in mc.failures}
+
+    def test_digest_pinned_base_passes_ds001(self):
+        mc = self._scan(
+            b"FROM alpine@sha256:abcd\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS001" not in {r.id for r in mc.failures}
+
+    def test_stage_ref_not_flagged(self):
+        mc = self._scan(
+            b"FROM golang:1.19 AS build\nFROM build\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS001" not in {r.id for r in mc.failures}
+
+
+class TestKubernetesPolicies:
+    def test_bad_pod(self):
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=BAD_K8S)])
+        assert len(out) == 1
+        mc = out[0]
+        assert mc.file_type == "kubernetes"
+        ids = {r.id for r in mc.failures}
+        assert ids == {"KSV001", "KSV006", "KSV012", "KSV017"}
+
+    def test_hardened_pod(self):
+        content = b"""apiVersion: v1
+kind: Pod
+metadata: {name: web}
+spec:
+  containers:
+    - name: app
+      image: nginx:1.23
+      securityContext:
+        privileged: false
+        allowPrivilegeEscalation: false
+        runAsNonRoot: true
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=content)])
+        assert out[0].failures == []
+
+    def test_non_k8s_yaml_skipped(self):
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="cfg.yaml",
+            content=b"foo: bar\n")])
+        assert out == []
+
+    def test_k8s_json(self):
+        doc = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "x"},
+               "spec": {"containers": [
+                   {"name": "c",
+                    "securityContext": {"privileged": True}}]}}
+        out = scan_config_files([ConfigFile(
+            type="json", file_path="pod.json",
+            content=json.dumps(doc).encode())])
+        assert "KSV017" in {r.id for r in out[0].failures}
+
+    def test_deployment_template_nesting(self):
+        content = b"""apiVersion: apps/v1
+kind: Deployment
+metadata: {name: web}
+spec:
+  template:
+    spec:
+      containers:
+        - name: app
+          securityContext:
+            privileged: true
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="deploy.yaml", content=content)])
+        assert "KSV017" in {r.id for r in out[0].failures}
+
+
+class TestEndToEnd:
+    def _run(self, argv):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+
+    def test_fs_config_scan(self, tmp_path):
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "Dockerfile").write_bytes(BAD_DOCKERFILE)
+        (tmp_path / "app" / "pod.yaml").write_bytes(BAD_K8S)
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "config",
+            "--format", "json", "--output", str(out_file),
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        by_target = {r["Target"]: r for r in report["Results"]}
+        dockerfile = by_target["Dockerfile"]
+        assert dockerfile["Class"] == "config"
+        assert dockerfile["Type"] == "dockerfile"
+        assert dockerfile["MisconfSummary"]["Failures"] == 5
+        ids = {m["ID"] for m in dockerfile["Misconfigurations"]}
+        assert "DS002" in ids
+        root_user = [m for m in dockerfile["Misconfigurations"]
+                     if m["ID"] == "DS002"][0]
+        assert root_user["Status"] == "FAIL"
+        assert root_user["Severity"] == "HIGH"
+        assert root_user["PrimaryURL"] == \
+            "https://avd.aquasec.com/misconfig/ds002"
+        pod = by_target["pod.yaml"]
+        assert pod["Type"] == "kubernetes"
+        assert pod["MisconfSummary"]["Failures"] == 4
+
+    def test_include_non_failures(self, tmp_path):
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "Dockerfile").write_bytes(
+            GOOD_DOCKERFILE)
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "config",
+            "--include-non-failures",
+            "--format", "json", "--output", str(out_file),
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        r = report["Results"][0]
+        assert r["MisconfSummary"]["Successes"] == 5
+        assert all(m["Status"] == "PASS"
+                   for m in r["Misconfigurations"])
+
+    def test_config_check_affects_exit_code(self, tmp_path):
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "Dockerfile").write_bytes(BAD_DOCKERFILE)
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "config", "--exit-code", "3",
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 3
+
+    def test_fs_config_scan_with_disk_cache(self, tmp_path):
+        """Misconfigurations must survive the FSCache JSON round-trip
+        (review finding: blob deserializer dropped them)."""
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "Dockerfile").write_bytes(BAD_DOCKERFILE)
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "config",
+            "--format", "json", "--output", str(out_file),
+            "--cache-dir", str(tmp_path / "cache")])   # disk cache
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        assert report["Results"][0]["MisconfSummary"]["Failures"] == 5
+        # second run hits the cached blob — findings identical
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "config",
+            "--format", "json", "--output", str(out_file),
+            "--cache-dir", str(tmp_path / "cache")])
+        report2 = json.loads(out_file.read_text())
+        assert report2["Results"] == report["Results"]
+
+    def test_summary_reported_for_all_pass_file(self, tmp_path):
+        """An all-passing config file still reports its summary
+        (review finding: Result.empty dropped it)."""
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "Dockerfile").write_bytes(
+            GOOD_DOCKERFILE)
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "config",
+            "--format", "json", "--output", str(out_file),
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        assert report["Results"][0]["MisconfSummary"][
+            "Successes"] == 5
+        assert "Misconfigurations" not in report["Results"][0]
+
+    def test_container_level_run_as_nonroot_false(self):
+        """Container securityContext overrides pod-level
+        (review finding: OR masked an explicit false)."""
+        content = b"""apiVersion: v1
+kind: Pod
+metadata: {name: web}
+spec:
+  securityContext: {runAsNonRoot: true}
+  containers:
+    - name: app
+      securityContext:
+        runAsNonRoot: false
+        allowPrivilegeEscalation: false
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=content)])
+        assert "KSV012" in {r.id for r in out[0].failures}
+
+    def test_blank_line_in_continuation(self):
+        stages = parse(b"FROM a:1\nRUN apk add \\\n\n    curl\n"
+                       b"USER app\n")
+        assert [i.cmd for i in stages[0].instructions] == \
+            ["RUN", "USER"]
+        assert stages[0].instructions[0].value == "apk add curl"
+
+    def test_config_files_not_collected_without_check(self, tmp_path):
+        """vuln/secret scans must not pay config-collection costs."""
+        from trivy_tpu.artifact import ArtifactOption, LocalFSArtifact
+        from trivy_tpu.artifact.cache import MemoryCache
+        (tmp_path / "Dockerfile").write_bytes(BAD_DOCKERFILE)
+        cache = MemoryCache()
+        ref = LocalFSArtifact(
+            str(tmp_path), cache,
+            option=ArtifactOption(scan_secrets=False)).inspect()
+        blob = cache.get_blob(ref.blob_ids[0])
+        assert blob.misconfigurations == []
+        assert blob.config_files == []

@@ -40,6 +40,7 @@ class ArtifactOption:
     insecure: bool = False
     secret_scanner: object = None      # BatchSecretScanner (shared)
     scan_secrets: bool = True
+    scan_misconfig: bool = False       # IaC config collection
 
 
 def _secret_scanner(opt: ArtifactOption):
@@ -49,6 +50,16 @@ def _secret_scanner(opt: ArtifactOption):
     return opt.secret_scanner
 
 
+def _effective_disabled(opt: ArtifactOption) -> list:
+    """Config collectors only run when misconfig scanning is on
+    (the reference registers them behind the misconf option)."""
+    disabled = list(opt.disabled_analyzers)
+    if not opt.scan_misconfig:
+        from ..analyzer.config import CONFIG_ANALYZER_TYPES
+        disabled.extend(CONFIG_ANALYZER_TYPES)
+    return disabled
+
+
 class ImageArtifact:
     def __init__(self, image: ImageSource, cache,
                  option: Optional[ArtifactOption] = None):
@@ -56,7 +67,7 @@ class ImageArtifact:
         self.cache = cache
         self.opt = option or ArtifactOption()
         self.group = AnalyzerGroup(
-            disabled=self.opt.disabled_analyzers,
+            disabled=_effective_disabled(self.opt),
             file_patterns=self.opt.file_patterns)
 
     def inspect(self) -> ArtifactReference:
@@ -64,7 +75,8 @@ class ImageArtifact:
         opts_key = {"skip_dirs": self.opt.skip_dirs,
                     "skip_files": self.opt.skip_files,
                     "patterns": sorted(self.opt.file_patterns),
-                    "secrets": self.opt.scan_secrets}
+                    "secrets": self.opt.scan_secrets,
+                    "misconfig": self.opt.scan_misconfig}
         versions = dict(self.group.versions())
         versions.update({f"handler/{k}": v
                          for k, v in handler_versions().items()})
@@ -167,7 +179,7 @@ class LocalFSArtifact:
         self.cache = cache
         self.opt = option or ArtifactOption()
         self.group = AnalyzerGroup(
-            disabled=self.opt.disabled_analyzers,
+            disabled=_effective_disabled(self.opt),
             file_patterns=self.opt.file_patterns)
 
     def inspect(self) -> ArtifactReference:

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="output template ('@path' or inline), "
                         "used with --format template")
         sp.add_argument("--ignore-unfixed", action="store_true")
+        sp.add_argument("--include-non-failures",
+                        action="store_true",
+                        help="include passed/excepted misconfig "
+                        "checks in the results")
         sp.add_argument("--ignorefile", default=".trivyignore")
         sp.add_argument("--exit-code", type=int, default=0)
         sp.add_argument("--skip-dirs", default="")
@@ -261,6 +265,7 @@ def _artifact_option(args) -> ArtifactOption:
         skip_files=[f for f in args.skip_files.split(",") if f],
         secret_scanner=scanner,
         scan_secrets="secret" in checks,
+        scan_misconfig="config" in checks,
     )
 
 
@@ -284,7 +289,9 @@ def _finish(args, report: Report) -> int:
     results = filter_results(
         report.results, _severities(args.severity),
         ignore_unfixed=args.ignore_unfixed,
-        ignored_ids=load_ignore_file(args.ignorefile))
+        ignored_ids=load_ignore_file(args.ignorefile),
+        include_non_failures=getattr(args, "include_non_failures",
+                                     False))
     report.results = [r for r in results if not r.empty()]
     out = open(args.output, "w") if args.output else sys.stdout
     try:

@@ -8,6 +8,7 @@ a handler change invalidates cached blobs.
 from .handler import (PostHandler, handler_versions, post_handle,
                       register_post_handler)
 from . import gomod as _gomod  # noqa: F401  (registers on import)
+from . import misconf as _misconf  # noqa: F401
 
 __all__ = ["PostHandler", "register_post_handler", "post_handle",
            "handler_versions"]

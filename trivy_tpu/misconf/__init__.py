@@ -1,0 +1,123 @@
+"""Misconfiguration scanning engine
+(reference: pkg/fanal/handler/misconf/misconf.go:149-338 + defsec).
+
+Evaluates the built-in policy sets against collected ConfigFiles and
+produces blob-level Misconfigurations: per file, every applicable
+policy lands in ``failures`` (with cause lines) or ``successes`` —
+resultsToMisconf's shape (misconf.go:338-). Host-side: policy
+evaluation is irregular tree-walking, not kernel work.
+"""
+
+from __future__ import annotations
+
+import json as json_mod
+
+from ..types import Misconfiguration
+from ..types.report import CauseMetadata, MisconfResult
+from ..utils import get_logger
+from . import dockerfile as dockerfile_mod
+from .policies import (DOCKERFILE_POLICIES, KUBERNETES_POLICIES,
+                       Policy)
+
+log = get_logger("misconf")
+
+try:
+    import yaml as yaml_mod
+except ImportError:          # pragma: no cover
+    yaml_mod = None
+
+
+def _is_kubernetes(doc) -> bool:
+    return isinstance(doc, dict) and "apiVersion" in doc and \
+        "kind" in doc
+
+
+def _parse_docs(config_file):
+    """ConfigFile → (file_type, parsed docs or None)."""
+    if config_file.type == "dockerfile":
+        return "dockerfile", dockerfile_mod.parse(config_file.content)
+    if config_file.type in ("yaml", "helm"):
+        if yaml_mod is None:
+            return None, None
+        try:
+            docs = [d for d in yaml_mod.safe_load_all(
+                config_file.content.decode("utf-8", "replace"))
+                if d is not None]
+        except yaml_mod.YAMLError as e:
+            log.debug("yaml parse error in %s: %s",
+                      config_file.file_path, e)
+            return None, None
+        k8s = [d for d in docs if _is_kubernetes(d)]
+        if k8s:
+            return "kubernetes", k8s
+        return None, None
+    if config_file.type == "json":
+        try:
+            doc = json_mod.loads(config_file.content)
+        except ValueError:
+            return None, None
+        if _is_kubernetes(doc):
+            return "kubernetes", [doc]
+        return None, None
+    return None, None
+
+
+def _result(policy: Policy, file_type: str, message: str,
+            cause=None) -> MisconfResult:
+    return MisconfResult(
+        namespace=f"builtin.{file_type}.{policy.id}",
+        query="data.builtin." + file_type,
+        message=message,
+        id=policy.id,
+        avd_id=policy.avd_id,
+        type=f"{'Dockerfile' if file_type == 'dockerfile' else 'Kubernetes'} Security Check",
+        title=policy.title,
+        description=policy.description,
+        severity=policy.severity,
+        recommended_actions=policy.recommended_actions,
+        references=list(policy.references),
+        cause_metadata=CauseMetadata(
+            provider=policy.provider,
+            service=policy.service,
+            start_line=getattr(cause, "start_line", 0),
+            end_line=getattr(cause, "end_line", 0)),
+    )
+
+
+def scan_config_files(config_files: list) -> list:
+    """[ConfigFile] → [Misconfiguration], sorted per
+    misconf.go:300-321."""
+    out = []
+    for cf in config_files:
+        file_type, docs = _parse_docs(cf)
+        if file_type is None:
+            continue
+        policies = DOCKERFILE_POLICIES if file_type == "dockerfile" \
+            else KUBERNETES_POLICIES
+        successes, failures = [], []
+        for policy in policies:
+            causes = []
+            if file_type == "dockerfile":
+                causes = policy.check(docs)
+            else:
+                for doc in docs:
+                    causes.extend(policy.check(doc))
+            if causes:
+                for cause in causes:
+                    failures.append(_result(
+                        policy, file_type, cause.message, cause))
+            else:
+                successes.append(_result(
+                    policy, file_type, policy.success_message))
+        successes.sort(key=lambda r: (r.avd_id,
+                                      r.cause_metadata.start_line))
+        failures.sort(key=lambda r: (r.avd_id,
+                                     r.cause_metadata.start_line))
+        out.append(Misconfiguration(
+            file_type=file_type,
+            file_path=cf.file_path,
+            successes=successes,
+            failures=failures,
+        ))
+    out.sort(key=lambda m: m.file_path)
+    return out

@@ -1,0 +1,315 @@
+"""Built-in misconfiguration policies.
+
+Python re-implementations of the best-known defsec built-in checks
+(IDs/AVD IDs/titles/severities are the compat contract — the
+reference embeds these in defsec's Go checks). Each policy's
+``check(doc)`` returns a list of Causes; empty list = pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .dockerfile import Stage
+
+
+@dataclass
+class Cause:
+    message: str
+    start_line: int = 0
+    end_line: int = 0
+    resource: str = ""
+
+
+@dataclass
+class Policy:
+    id: str
+    avd_id: str
+    title: str
+    description: str
+    severity: str
+    recommended_actions: str
+    references: list
+    provider: str
+    service: str
+    check: Callable          # (parsed doc) -> list[Cause]
+    success_message: str = "No issues found"
+
+
+# ------------------------------------------------------------ dockerfile
+
+
+def _last_user(stage: Stage):
+    user = None
+    for inst in stage.instructions:
+        if inst.cmd == "USER":
+            user = inst
+    return user
+
+
+def _check_root_user(stages: list) -> list:
+    """The FINAL stage decides who runs the container."""
+    if not stages:
+        return []
+    stage = stages[-1]
+    user = _last_user(stage)
+    if user is None:
+        line = max(1, stage.start_line)
+        return [Cause(
+            message="Specify at least 1 USER command in Dockerfile "
+            "with non-root user as argument",
+            start_line=line, end_line=line)]
+    if user.value.split(":")[0] in ("root", "0"):
+        return [Cause(
+            message="Last USER command in Dockerfile should not be "
+            f"'root' but it is {user.value!r}",
+            start_line=user.start_line, end_line=user.end_line)]
+    return []
+
+
+def _check_latest_tag(stages: list) -> list:
+    causes = []
+    earlier_stages = set()
+    for stage in stages:
+        base = stage.base
+        if base and base not in earlier_stages and \
+                not base.startswith("$") and "@" not in base:
+            # tag = whatever follows ':' in the last path segment
+            segment = base.rsplit("/", 1)[-1]
+            _, sep, tag = segment.partition(":")
+            if not sep or tag == "latest":
+                causes.append(Cause(
+                    message="Specify a tag in the 'FROM' statement "
+                    f"for image '{segment.split(':')[0]}'",
+                    start_line=stage.start_line,
+                    end_line=stage.start_line))
+        earlier_stages.add(stage.name)
+    return causes
+
+
+def _check_add(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd != "ADD":
+                continue
+            src = inst.value.split()[0] if inst.value.split() else ""
+            # ADD is legitimate for remote URLs and auto-extraction
+            if src.startswith(("http://", "https://")) or \
+                    src.endswith((".tar", ".tar.gz", ".tgz",
+                                  ".tar.bz2", ".tar.xz", ".zip")):
+                continue
+            causes.append(Cause(
+                message=f"Consider using 'COPY {inst.value}' "
+                "command instead of 'ADD' command",
+                start_line=inst.start_line,
+                end_line=inst.end_line))
+    return causes
+
+
+def _check_exposed_22(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd == "EXPOSE":
+                for port in inst.value.split():
+                    if port.split("/")[0] == "22":
+                        causes.append(Cause(
+                            message="Port 22 should not be exposed "
+                            "in Dockerfile",
+                            start_line=inst.start_line,
+                            end_line=inst.end_line))
+    return causes
+
+
+def _check_healthcheck(stages: list) -> list:
+    if any(inst.cmd == "HEALTHCHECK"
+           for s in stages for inst in s.instructions):
+        return []
+    return [Cause(message="Add HEALTHCHECK instruction in your "
+                  "Dockerfile", start_line=1, end_line=1)]
+
+
+DOCKERFILE_POLICIES = [
+    Policy(id="DS001", avd_id="AVD-DS-0001",
+           title="':latest' tag used",
+           description="When using a 'FROM' statement you should use "
+           "a specific tag to avoid uncontrolled behavior when the "
+           "image is updated.",
+           severity="MEDIUM",
+           recommended_actions="Add a tag to the image in the 'FROM' "
+           "statement",
+           references=["https://avd.aquasec.com/misconfig/ds001"],
+           provider="Generic", service="general",
+           check=_check_latest_tag),
+    Policy(id="DS002", avd_id="AVD-DS-0002",
+           title="Image user should not be 'root'",
+           description="Running containers with 'root' user can lead "
+           "to a container escape situation. It is a best practice "
+           "to run containers as non-root users, which can be done "
+           "by adding a 'USER' statement to the Dockerfile.",
+           severity="HIGH",
+           recommended_actions="Add 'USER <non root user name>' line "
+           "to the Dockerfile",
+           references=["https://docs.docker.com/develop/"
+                       "develop-images/dockerfile_best-practices/",
+                       "https://avd.aquasec.com/misconfig/ds002"],
+           provider="Generic", service="general",
+           check=_check_root_user),
+    Policy(id="DS004", avd_id="AVD-DS-0004",
+           title="Port 22 exposed",
+           description="Exposing port 22 might allow users to SSH "
+           "into the container.",
+           severity="MEDIUM",
+           recommended_actions="Remove 'EXPOSE 22' statement from "
+           "the Dockerfile",
+           references=["https://avd.aquasec.com/misconfig/ds004"],
+           provider="Generic", service="general",
+           check=_check_exposed_22),
+    Policy(id="DS005", avd_id="AVD-DS-0005",
+           title="ADD instead of COPY",
+           description="You should use COPY instead of ADD unless "
+           "you want to extract a tar file. Note that an ADD command "
+           "will extract a tar file, which adds the risk of Zip-based "
+           "vulnerabilities. Accordingly, it is advised to use a COPY "
+           "command, which does not extract tar files.",
+           severity="LOW",
+           recommended_actions="Use COPY instead of ADD",
+           references=["https://avd.aquasec.com/misconfig/ds005"],
+           provider="Generic", service="general",
+           check=_check_add),
+    Policy(id="DS026", avd_id="AVD-DS-0026",
+           title="No HEALTHCHECK defined",
+           description="You should add HEALTHCHECK instruction in "
+           "your docker container images to perform the health check "
+           "on running containers.",
+           severity="LOW",
+           recommended_actions="Add HEALTHCHECK instruction in "
+           "Dockerfile",
+           references=["https://avd.aquasec.com/misconfig/ds026"],
+           provider="Generic", service="general",
+           check=_check_healthcheck),
+]
+
+
+# ------------------------------------------------------------ kubernetes
+
+
+def _k8s_containers(doc: dict):
+    spec = doc.get("spec") or {}
+    # workloads nest pod specs under template
+    tmpl = (spec.get("template") or {}).get("spec") or {}
+    pod = tmpl or spec
+    for kind in ("initContainers", "containers"):
+        for c in pod.get(kind) or []:
+            yield c, pod
+
+
+def _k8s_check_privileged(doc: dict) -> list:
+    causes = []
+    for c, _ in _k8s_containers(doc):
+        sc = c.get("securityContext") or {}
+        if sc.get("privileged"):
+            causes.append(Cause(
+                message=f"Container {c.get('name', '?')!r} of "
+                f"{doc.get('kind', '?')} "
+                f"{(doc.get('metadata') or {}).get('name', '?')!r} "
+                "should set 'securityContext.privileged' to false",
+                resource=c.get("name", "")))
+    return causes
+
+
+def _k8s_check_priv_escalation(doc: dict) -> list:
+    causes = []
+    for c, _ in _k8s_containers(doc):
+        sc = c.get("securityContext") or {}
+        if sc.get("allowPrivilegeEscalation", True):
+            causes.append(Cause(
+                message=f"Container {c.get('name', '?')!r} of "
+                f"{doc.get('kind', '?')} "
+                f"{(doc.get('metadata') or {}).get('name', '?')!r} "
+                "should set "
+                "'securityContext.allowPrivilegeEscalation' to false",
+                resource=c.get("name", "")))
+    return causes
+
+
+def _k8s_check_run_as_nonroot(doc: dict) -> list:
+    causes = []
+    for c, pod in _k8s_containers(doc):
+        csc = c.get("securityContext") or {}
+        psc = pod.get("securityContext") or {}
+        # container-level setting overrides the pod-level one
+        effective = csc.get("runAsNonRoot")
+        if effective is None:
+            effective = psc.get("runAsNonRoot")
+        if not effective:
+            causes.append(Cause(
+                message=f"Container {c.get('name', '?')!r} of "
+                f"{doc.get('kind', '?')} "
+                f"{(doc.get('metadata') or {}).get('name', '?')!r} "
+                "should set 'securityContext.runAsNonRoot' to true",
+                resource=c.get("name", "")))
+    return causes
+
+
+def _k8s_check_docker_sock(doc: dict) -> list:
+    causes = []
+    spec = doc.get("spec") or {}
+    pod = (spec.get("template") or {}).get("spec") or spec
+    for vol in pod.get("volumes") or []:
+        host_path = (vol.get("hostPath") or {}).get("path", "")
+        if host_path.rstrip("/") == "/var/run/docker.sock":
+            causes.append(Cause(
+                message=f"{doc.get('kind', '?')} "
+                f"{(doc.get('metadata') or {}).get('name', '?')!r} "
+                "should not mount '/var/run/docker.sock'",
+                resource=vol.get("name", "")))
+    return causes
+
+
+KUBERNETES_POLICIES = [
+    Policy(id="KSV001", avd_id="AVD-KSV-0001",
+           title="Process can elevate its own privileges",
+           description="A program inside the container can elevate "
+           "its own privileges and run as root, which might give the "
+           "program control over the container and node.",
+           severity="MEDIUM",
+           recommended_actions="Set 'set containers[].securityContext"
+           ".allowPrivilegeEscalation' to 'false'.",
+           references=["https://avd.aquasec.com/misconfig/ksv001"],
+           provider="Kubernetes", service="general",
+           check=_k8s_check_priv_escalation),
+    Policy(id="KSV006", avd_id="AVD-KSV-0006",
+           title="hostPath volume mounted with docker.sock",
+           description="Mounting docker.sock from the host can give "
+           "the container full root access to the host.",
+           severity="HIGH",
+           recommended_actions="Do not specify /var/run/docker.sock "
+           "in 'spec.template.volumes.hostPath.path'.",
+           references=["https://avd.aquasec.com/misconfig/ksv006"],
+           provider="Kubernetes", service="general",
+           check=_k8s_check_docker_sock),
+    Policy(id="KSV012", avd_id="AVD-KSV-0012",
+           title="Runs as root user",
+           description="'runAsNonRoot' forces the running image to "
+           "run as a non-root user to ensure least privileges.",
+           severity="MEDIUM",
+           recommended_actions="Set 'containers[].securityContext."
+           "runAsNonRoot' to true.",
+           references=["https://avd.aquasec.com/misconfig/ksv012"],
+           provider="Kubernetes", service="general",
+           check=_k8s_check_run_as_nonroot),
+    Policy(id="KSV017", avd_id="AVD-KSV-0017",
+           title="Privileged container",
+           description="Privileged containers share namespaces with "
+           "the host system and do not offer any security. They "
+           "should be used exclusively for system containers.",
+           severity="HIGH",
+           recommended_actions="Change 'containers[].securityContext"
+           ".privileged' to 'false'.",
+           references=["https://avd.aquasec.com/misconfig/ksv017"],
+           provider="Kubernetes", service="general",
+           check=_k8s_check_privileged),
+]

@@ -29,7 +29,8 @@ def load_ignore_file(path: str = ".trivyignore") -> list:
 def filter_results(results: list, severities: list,
                    ignore_unfixed: bool = False,
                    ignored_ids: Optional[list] = None,
-                   policy: Optional[Callable] = None) -> list:
+                   policy: Optional[Callable] = None,
+                   include_non_failures: bool = False) -> list:
     sev_names = {str(s) if isinstance(s, Severity) else s
                  for s in severities}
     ignored = set(ignored_ids or [])
@@ -38,14 +39,43 @@ def filter_results(results: list, severities: list,
         r.vulnerabilities = _filter_vulns(
             r.vulnerabilities, sev_names, ignore_unfixed, ignored,
             policy)
-        r.misconfigurations = [
-            m for m in r.misconfigurations
-            if getattr(m, "severity", "") in sev_names
-            and getattr(m, "id", "") not in ignored]
+        r.misconf_summary, r.misconfigurations = _filter_misconfs(
+            r.misconfigurations, sev_names, ignored,
+            include_non_failures)
         r.secrets = [s for s in r.secrets
                      if s.severity in sev_names
                      and s.rule_id not in ignored]
     return results
+
+
+def _filter_misconfs(misconfs: list, sev_names: set, ignored: set,
+                     include_non_failures: bool) -> tuple:
+    """filterMisconfigurations (filter.go:124-154): severity/id
+    filter, PASS/EXCEPTION dropped unless requested, and a
+    pass/fail/exception summary."""
+    from ..types.report import MisconfSummary
+    summary = MisconfSummary()
+    filtered = []
+    for m in misconfs:
+        if getattr(m, "severity", "") not in sev_names:
+            continue
+        if getattr(m, "id", "") in ignored or \
+                getattr(m, "avd_id", "") in ignored:
+            continue
+        status = getattr(m, "status", "")
+        if status == "FAIL":
+            summary.failures += 1
+        elif status == "PASS":
+            summary.successes += 1
+        elif status == "EXCEPTION":
+            summary.exceptions += 1
+        if status != "FAIL" and not include_non_failures:
+            continue
+        filtered.append(m)
+    if not (summary.failures or summary.successes or
+            summary.exceptions):
+        return None, []
+    return summary, filtered
 
 
 def _filter_vulns(vulns: list, sev_names: set, ignore_unfixed: bool,

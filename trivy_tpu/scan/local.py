@@ -337,12 +337,55 @@ class LocalScanner:
         return out
 
     def _misconf_results(self, detail) -> list:
+        """misconfsToResults (ref local/scan.go:337-371): flatten each
+        file's failures/warnings/successes into status-tagged
+        DetectedMisconfigurations."""
         out = []
         for mc in detail.misconfigurations:
+            detected = []
+            for f in mc.failures:
+                detected.append(_to_detected_misconf(
+                    f, "CRITICAL", "FAIL", mc.layer))
+            for w in mc.warnings:
+                detected.append(_to_detected_misconf(
+                    w, "MEDIUM", "FAIL", mc.layer))
+            for s in mc.successes:
+                detected.append(_to_detected_misconf(
+                    s, "UNKNOWN", "PASS", mc.layer))
+            for e in mc.exceptions:
+                detected.append(_to_detected_misconf(
+                    e, "UNKNOWN", "EXCEPTION", mc.layer))
             out.append(Result(
                 target=mc.file_path,
                 class_=ResultClass.CONFIG,
                 type=mc.file_type,
-                misconfigurations=[mc],
+                misconfigurations=detected,
             ))
+        out.sort(key=lambda r: r.target)
         return out
+
+
+def _to_detected_misconf(res, default_severity: str, status: str,
+                         layer):
+    """toDetectedMisconfiguration (ref local/scan.go:398-452)."""
+    from ..types.report import DetectedMisconfiguration
+
+    severity = res.severity or default_severity
+    msg = (res.message or "").strip() or "No issues found"
+    references = list(res.references)
+    primary_url = ""
+    if not res.namespace or res.namespace.startswith("builtin."):
+        primary_url = ("https://avd.aquasec.com/misconfig/"
+                       f"{res.id.lower()}")
+        if primary_url not in references:
+            references.append(primary_url)
+    if not primary_url and references:
+        primary_url = references[0]
+    return DetectedMisconfiguration(
+        type=res.type, id=res.id, avd_id=res.avd_id,
+        title=res.title, description=res.description,
+        message=msg, namespace=res.namespace, query=res.query,
+        resolution=res.recommended_actions,
+        severity=severity, primary_url=primary_url,
+        references=references, status=status, layer=layer,
+        cause_metadata=res.cause_metadata)

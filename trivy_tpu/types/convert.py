@@ -192,6 +192,43 @@ def result_from_dict(x: dict) -> Result:
     )
 
 
+def misconf_result_from_dict(x: dict) -> "MisconfResult":
+    from .report import MisconfResult
+    return MisconfResult(
+        namespace=x.get("Namespace", ""),
+        query=x.get("Query", ""),
+        message=x.get("Message", ""),
+        id=x.get("ID", ""),
+        avd_id=x.get("AVDID", ""),
+        type=x.get("Type", ""),
+        title=x.get("Title", ""),
+        description=x.get("Description", ""),
+        severity=x.get("Severity", ""),
+        recommended_actions=x.get("RecommendedActions", ""),
+        references=x.get("References") or [],
+        status=x.get("Status", ""),
+        cause_metadata=cause_metadata_from_dict(
+            x.get("CauseMetadata")),
+    )
+
+
+def misconfiguration_from_dict(x: dict):
+    from . import Misconfiguration
+    return Misconfiguration(
+        file_type=x.get("FileType", ""),
+        file_path=x.get("FilePath", ""),
+        successes=[misconf_result_from_dict(r)
+                   for r in x.get("Successes") or []],
+        warnings=[misconf_result_from_dict(r)
+                  for r in x.get("Warnings") or []],
+        failures=[misconf_result_from_dict(r)
+                  for r in x.get("Failures") or []],
+        exceptions=[misconf_result_from_dict(r)
+                    for r in x.get("Exceptions") or []],
+        layer=layer_from_dict(x.get("Layer")),
+    )
+
+
 def blob_info_from_dict(d: dict) -> BlobInfo:
     repo = None
     if d.get("Repository"):
@@ -220,6 +257,9 @@ def blob_info_from_dict(d: dict) -> BlobInfo:
                        file_path=cf.get("FilePath", ""),
                        content=(cf.get("Content") or "").encode())
             for cf in d.get("ConfigFiles") or []],
+        misconfigurations=[misconfiguration_from_dict(m)
+                           for m in
+                           d.get("Misconfigurations") or []],
         secrets=[secret_from_dict(s)
                  for s in d.get("Secrets") or []],
         opaque_dirs=d.get("OpaqueDirs") or [],

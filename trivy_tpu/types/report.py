@@ -196,6 +196,10 @@ class Result:
         return asdict_omitempty(self)
 
     def empty(self) -> bool:
+        # a summary of all-passing checks is still a reportable
+        # result (ref: MisconfSummary emitted with no failures)
+        if self.misconf_summary is not None:
+            return False
         return not (self.packages or self.vulnerabilities or
                     self.misconfigurations or self.secrets or self.licenses or
                     self.custom_resources)
