@@ -1,0 +1,219 @@
+"""License scanning tests (mirrors pkg/licensing scanner/classifier
+tests + dpkg copyright analyzer + the license result class)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.licensing import (DEFAULT_CATEGORIES, LicenseScanner,
+                                 normalize)
+from trivy_tpu.licensing.classifier import classify, classify_findings
+
+MIT_TEXT = b"""MIT License
+
+Copyright (c) 2024 Example
+
+Permission is hereby granted, free of charge, to any person obtaining a copy
+of this software and associated documentation files (the "Software"), to deal
+in the Software without restriction...
+"""
+
+GPL2_COPYRIGHT = b"""Format: https://www.debian.org/doc/packaging-manuals/copyright-format/1.0/
+Upstream-Name: zlib1g
+
+Files: *
+License: Zlib
+
+Files: debian/*
+License: GPL-2+
+ On Debian systems the full text can be found in
+ /usr/share/common-licenses/GPL-2
+"""
+
+
+class TestScanner:
+    def test_categories(self):
+        s = LicenseScanner()
+        assert s.scan("GPL-3.0") == ("restricted", "HIGH")
+        assert s.scan("AGPL-3.0") == ("forbidden", "CRITICAL")
+        assert s.scan("MPL-2.0") == ("reciprocal", "MEDIUM")
+        assert s.scan("MIT") == ("notice", "LOW")
+        assert s.scan("Unlicense") == ("unencumbered", "LOW")
+        assert s.scan("MadeUp-1.0") == ("unknown", "UNKNOWN")
+
+    def test_custom_categories_override(self):
+        s = LicenseScanner({"forbidden": ["MIT"]})
+        assert s.scan("MIT") == ("forbidden", "CRITICAL")
+        assert s.scan("GPL-3.0") == ("unknown", "UNKNOWN")
+
+
+class TestNormalize:
+    def test_mappings(self):
+        assert normalize("GPL-2+") == "GPL-2.0"
+        assert normalize("LGPLv2.1+") == "LGPL-2.1"
+        assert normalize("BSD") == "BSD-3-Clause"
+        assert normalize("Apache 2.0") == "Apache-2.0"
+        assert normalize("MIT") == "MIT"     # unmapped stays
+
+    def test_scanner_normalizes_before_lookup(self):
+        """review r1: raw SPDX/vendor forms must category-map."""
+        s = LicenseScanner()
+        assert s.scan("GPL-3.0-only") == ("restricted", "HIGH")
+        assert s.scan("GPLv2+") == ("restricted", "HIGH")
+        assert s.scan("Apache-2.0-or-later")[0] == "notice"
+
+    def test_spdx_with_exception_not_own_finding(self):
+        """review r2: WITH qualifies the license, it is not one."""
+        findings = classify_findings(
+            b"// SPDX-License-Identifier: GPL-2.0-only WITH "
+            b"Classpath-exception-2.0\n")
+        assert [f.name for f in findings] == ["GPL-2.0-only"]
+
+    def test_repeated_untagged_from_both_flagged(self):
+        """review r3: unnamed stages aren't FROM-able references."""
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        mc = scan_config_files([ConfigFile(
+            type="dockerfile", file_path="Dockerfile",
+            content=b"FROM node\nRUN build\nFROM node\nUSER app\n"
+                    b"HEALTHCHECK CMD true\n")])[0]
+        ds001 = [r for r in mc.failures if r.id == "DS001"]
+        assert len(ds001) == 2
+
+
+class TestClassifier:
+    def test_mit_full_text(self):
+        findings = classify_findings(MIT_TEXT)
+        assert [f.name for f in findings] == ["MIT"]
+        assert findings[0].confidence == 0.9
+
+    def test_spdx_identifier(self):
+        findings = classify_findings(
+            b"// SPDX-License-Identifier: Apache-2.0\nint main(){}\n")
+        assert [f.name for f in findings] == ["Apache-2.0"]
+        assert findings[0].confidence == 1.0
+
+    def test_spdx_expression(self):
+        findings = classify_findings(
+            b"# SPDX-License-Identifier: MIT OR GPL-2.0\n")
+        assert {f.name for f in findings} == {"MIT", "GPL-2.0"}
+
+    def test_binary_not_classified(self):
+        from trivy_tpu.licensing.classifier import is_human_readable
+        assert not is_human_readable(b"\x00\x01\x02binary")
+        assert is_human_readable(MIT_TEXT)
+
+    def test_classify_file_types(self):
+        full = classify("LICENSE", MIT_TEXT, full=True)
+        assert full.type == "license-file"
+        header = classify("main.c", MIT_TEXT, full=False)
+        assert header.type == "header"
+
+
+class TestDpkgCopyright:
+    def test_parse(self):
+        from trivy_tpu.analyzer.licensing import DpkgLicenseAnalyzer
+        a = DpkgLicenseAnalyzer()
+        assert a.required("usr/share/doc/zlib1g/copyright")
+        assert not a.required("usr/share/doc/zlib1g/README")
+        r = a.analyze("usr/share/doc/zlib1g/copyright",
+                      GPL2_COPYRIGHT)
+        assert len(r.licenses) == 1
+        lf = r.licenses[0]
+        assert lf.pkg_name == "zlib1g"
+        assert lf.type == "dpkg-license"
+        assert [f.name for f in lf.findings] == ["Zlib", "GPL-2.0"]
+
+
+class TestEndToEnd:
+    def _run(self, argv):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+
+    def test_fs_license_scan(self, tmp_path):
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "LICENSE").write_bytes(MIT_TEXT)
+        (tmp_path / "app" / "main.py").write_bytes(
+            b"# SPDX-License-Identifier: GPL-3.0\nprint('hi')\n")
+        (tmp_path / "app" / "package-lock.json").write_text(
+            json.dumps({
+                "dependencies": {
+                    "left-pad": {"version": "1.3.0"}}}))
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "fs", str(tmp_path / "app"),
+            "--security-checks", "license",
+            "--format", "json", "--output", str(out_file),
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        loose = [r for r in report["Results"]
+                 if r["Class"] == "license-file"][0]
+        names = {(lic["FilePath"], lic["Name"]):
+                 lic for lic in loose["Licenses"]}
+        mit = names[("LICENSE", "MIT")]
+        assert mit["Category"] == "notice"
+        assert mit["Severity"] == "LOW"
+        gpl = names[("main.py", "GPL-3.0")]
+        assert gpl["Category"] == "restricted"
+        assert gpl["Severity"] == "HIGH"
+
+    def test_image_dpkg_license_merge(self, tmp_path):
+        """dpkg copyright findings merge into package records via the
+        applier, then surface in the license result class."""
+        from tests.test_e2e_image import make_image_tar
+        dpkg_status = (b"Package: zlib1g\nStatus: install ok "
+                       b"installed\nVersion: 1.2.11\n"
+                       b"Source: zlib\nArchitecture: amd64\n\n")
+        img = make_image_tar(tmp_path, [{
+            "etc/os-release":
+                b'ID=debian\nVERSION_ID="11"\n',
+            "var/lib/dpkg/status": dpkg_status,
+            "usr/share/doc/zlib1g/copyright": GPL2_COPYRIGHT,
+        }])
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "image", "--input", img,
+            "--security-checks", "license",
+            "--format", "json", "--output", str(out_file),
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        os_lic = [r for r in report["Results"]
+                  if r.get("Target") == "OS Packages"][0]
+        pairs = {(lic["PkgName"], lic["Name"])
+                 for lic in os_lic["Licenses"]}
+        assert ("zlib1g", "Zlib") in pairs
+        assert ("zlib1g", "GPL-2.0") in pairs
+
+    def test_license_analyzers_gated(self, tmp_path):
+        from trivy_tpu.artifact import ArtifactOption, LocalFSArtifact
+        from trivy_tpu.artifact.cache import MemoryCache
+        (tmp_path / "LICENSE").write_bytes(MIT_TEXT)
+        cache = MemoryCache()
+        ref = LocalFSArtifact(
+            str(tmp_path), cache,
+            option=ArtifactOption(scan_secrets=False)).inspect()
+        blob = cache.get_blob(ref.blob_ids[0])
+        assert blob.licenses == []
+
+    def test_license_severity_filter(self, tmp_path):
+        (tmp_path / "LICENSE").write_bytes(MIT_TEXT)
+        out_file = tmp_path / "report.json"
+        code, _ = self._run([
+            "fs", str(tmp_path),
+            "--security-checks", "license",
+            "--severity", "HIGH,CRITICAL",
+            "--format", "json", "--output", str(out_file),
+            "--no-cache", "--cache-dir", str(tmp_path / "cache")])
+        assert code == 0
+        report = json.loads(out_file.read_text())
+        assert not any(
+            lic for r in report.get("Results") or []
+            for lic in r.get("Licenses") or [])

@@ -20,6 +20,7 @@ from . import secret  # noqa: F401
 from . import language  # noqa: F401
 from . import rpm  # noqa: F401
 from . import config  # noqa: F401
+from . import licensing  # noqa: F401
 
 __all__ = ["Analyzer", "AnalysisResult", "AnalyzerGroup",
            "register_analyzer", "registered_analyzers"]

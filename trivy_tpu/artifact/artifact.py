@@ -41,6 +41,7 @@ class ArtifactOption:
     secret_scanner: object = None      # BatchSecretScanner (shared)
     scan_secrets: bool = True
     scan_misconfig: bool = False       # IaC config collection
+    scan_licenses: bool = False        # license classification
 
 
 def _secret_scanner(opt: ArtifactOption):
@@ -57,6 +58,9 @@ def _effective_disabled(opt: ArtifactOption) -> list:
     if not opt.scan_misconfig:
         from ..analyzer.config import CONFIG_ANALYZER_TYPES
         disabled.extend(CONFIG_ANALYZER_TYPES)
+    if not opt.scan_licenses:
+        from ..analyzer.licensing import LICENSE_ANALYZER_TYPES
+        disabled.extend(LICENSE_ANALYZER_TYPES)
     return disabled
 
 
@@ -76,7 +80,8 @@ class ImageArtifact:
                     "skip_files": self.opt.skip_files,
                     "patterns": sorted(self.opt.file_patterns),
                     "secrets": self.opt.scan_secrets,
-                    "misconfig": self.opt.scan_misconfig}
+                    "misconfig": self.opt.scan_misconfig,
+                    "licenses": self.opt.scan_licenses}
         versions = dict(self.group.versions())
         versions.update({f"handler/{k}": v
                          for k, v in handler_versions().items()})

@@ -266,6 +266,7 @@ def _artifact_option(args) -> ArtifactOption:
         secret_scanner=scanner,
         scan_secrets="secret" in checks,
         scan_misconfig="config" in checks,
+        scan_licenses="license" in checks,
     )
 
 

@@ -24,6 +24,7 @@ class Instruction:
 class Stage:
     name: str                 # "AS" name or the base image ref
     base: str                 # base image ref
+    alias: str = ""           # explicit "AS" name only
     instructions: list = field(default_factory=list)
     start_line: int = 0
 
@@ -67,11 +68,12 @@ def parse(content: bytes) -> list:
         if cmd == "FROM":
             tokens = rest.split()
             base = tokens[0] if tokens else ""
-            name = base
+            name, alias = base, ""
             for j, t in enumerate(tokens):
                 if t.upper() == "AS" and j + 1 < len(tokens):
-                    name = tokens[j + 1]
-            cur = Stage(name=name, base=base, start_line=start)
+                    name = alias = tokens[j + 1]
+            cur = Stage(name=name, base=base, alias=alias,
+                        start_line=start)
             stages.append(cur)
             continue
         if cur is None:

@@ -84,7 +84,9 @@ def _check_latest_tag(stages: list) -> list:
                     f"for image '{segment.split(':')[0]}'",
                     start_line=stage.start_line,
                     end_line=stage.start_line))
-        earlier_stages.add(stage.name)
+        if stage.alias:
+            # only AS aliases are resolvable as later FROM targets
+            earlier_stages.add(stage.alias)
     return causes
 
 

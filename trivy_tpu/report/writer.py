@@ -117,6 +117,16 @@ def render_table(report: Report,
                 rows.append((s.category, s.severity, s.title,
                              f"{s.start_line}-{s.end_line}"))
             lines.extend(_table(rows))
+        if result.licenses:
+            lines.append("")
+            lines.append(header + " (license)")
+            lines.append("=" * (len(header) + 10))
+            rows = [("Package/File", "License", "Category",
+                     "Severity")]
+            for lic in result.licenses:
+                rows.append((lic.pkg_name or lic.file_path,
+                             lic.name, lic.category, lic.severity))
+            lines.extend(_table(rows))
         if result.misconfigurations:
             lines.append("")
             lines.append(header + " (misconfigurations)")

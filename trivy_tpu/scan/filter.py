@@ -45,6 +45,9 @@ def filter_results(results: list, severities: list,
         r.secrets = [s for s in r.secrets
                      if s.severity in sev_names
                      and s.rule_id not in ignored]
+        r.licenses = [lic for lic in r.licenses
+                      if lic.severity in sev_names
+                      and lic.name not in ignored]
     return results
 
 

@@ -120,6 +120,10 @@ class LocalScanner:
         if "secret" in options.security_checks:
             results.extend(self._secret_results(detail))
 
+        if "license" in options.security_checks:
+            results.extend(self._license_results(
+                detail, getattr(options, "license_categories", None)))
+
         for r in results:
             fill_info(self.store, r.vulnerabilities)
         return results, detail.os
@@ -363,6 +367,57 @@ class LocalScanner:
             ))
         out.sort(key=lambda r: r.target)
         return out
+
+
+    def _license_results(self, detail, categories) -> list:
+        """scanLicenses (ref local/scan.go:372-396 + 145-149): OS
+        package licenses, per-application licenses, and loose-file
+        classifier findings, each category-mapped to a severity."""
+        from ..licensing import LicenseScanner
+        from ..types.report import DetectedLicense
+
+        scanner = LicenseScanner(categories or None)
+        results = []
+
+        os_licenses = []
+        for pkg in detail.packages:
+            for lic in pkg.licenses:
+                category, severity = scanner.scan(lic)
+                os_licenses.append(DetectedLicense(
+                    severity=severity, category=category,
+                    pkg_name=pkg.name, name=lic, confidence=1.0))
+        results.append(Result(
+            target="OS Packages", class_=ResultClass.LICENSE,
+            licenses=os_licenses))
+
+        for app in detail.applications:
+            app_licenses = []
+            for lib in app.libraries:
+                for lic in lib.licenses:
+                    category, severity = scanner.scan(lic)
+                    app_licenses.append(DetectedLicense(
+                        severity=severity, category=category,
+                        pkg_name=lib.name, name=lic,
+                        confidence=1.0))
+            target = app.file_path or _PKG_TARGETS.get(app.type, "")
+            results.append(Result(
+                target=target, class_=ResultClass.LICENSE,
+                licenses=app_licenses))
+
+        file_licenses = []
+        for lf in detail.licenses:
+            for finding in lf.findings:
+                category, severity = scanner.scan(finding.name)
+                file_licenses.append(DetectedLicense(
+                    severity=severity, category=category,
+                    file_path=lf.file_path, name=finding.name,
+                    confidence=finding.confidence,
+                    link=finding.link))
+        results.append(Result(
+            target="Loose File License(s)",
+            class_=ResultClass.LICENSE_FILE,
+            licenses=file_licenses))
+        return results
 
 
 def _to_detected_misconf(res, default_severity: str, status: str,

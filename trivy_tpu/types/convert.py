@@ -187,8 +187,22 @@ def result_from_dict(x: dict) -> Result:
                            x.get("Misconfigurations") or []],
         secrets=[secret_finding_from_dict(s)
                  for s in x.get("Secrets") or []],
-        licenses=x.get("Licenses") or [],
+        licenses=[detected_license_from_dict(lic)
+                  for lic in x.get("Licenses") or []],
         custom_resources=x.get("CustomResources") or [],
+    )
+
+
+def detected_license_from_dict(x: dict):
+    from .report import DetectedLicense
+    return DetectedLicense(
+        severity=x.get("Severity", ""),
+        category=x.get("Category", ""),
+        pkg_name=x.get("PkgName", ""),
+        file_path=x.get("FilePath", ""),
+        name=x.get("Name", ""),
+        confidence=x.get("Confidence", 0.0),
+        link=x.get("Link", ""),
     )
 
 
@@ -229,6 +243,22 @@ def misconfiguration_from_dict(x: dict):
     )
 
 
+def license_file_from_dict(x: dict):
+    from . import LicenseFile, LicenseFinding
+    return LicenseFile(
+        type=x.get("Type", ""),
+        file_path=x.get("FilePath", ""),
+        pkg_name=x.get("PkgName", ""),
+        findings=[LicenseFinding(
+            category=f.get("Category", ""),
+            name=f.get("Name", ""),
+            confidence=f.get("Confidence", 0.0),
+            link=f.get("Link", ""))
+            for f in x.get("Findings") or []],
+        layer=layer_from_dict(x.get("Layer")),
+    )
+
+
 def blob_info_from_dict(d: dict) -> BlobInfo:
     repo = None
     if d.get("Repository"):
@@ -262,6 +292,8 @@ def blob_info_from_dict(d: dict) -> BlobInfo:
                            d.get("Misconfigurations") or []],
         secrets=[secret_from_dict(s)
                  for s in d.get("Secrets") or []],
+        licenses=[license_file_from_dict(lf)
+                  for lf in d.get("Licenses") or []],
         opaque_dirs=d.get("OpaqueDirs") or [],
         whiteout_files=d.get("WhiteoutFiles") or [],
         system_files=d.get("SystemFiles") or [],

@@ -212,9 +212,7 @@ class Result:
         for m in self.misconfigurations:
             if getattr(m, "status", "") == "FAIL":
                 return True
-        for lic in self.licenses:
-            return True
-        return False
+        return bool(self.licenses)
 
 
 # Go's encoding/json cannot omit an empty struct: Metadata.ImageConfig
