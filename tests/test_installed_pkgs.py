@@ -1,0 +1,317 @@
+"""Installed-package & binary analyzer tests: jar, python-pkg,
+node-pkg, gemspec, gobinary, rustbinary, nuget, dotnet-core
+(mirrors go-dep-parser's parser tests at the behavior level)."""
+
+import io
+import json
+import struct
+import zipfile
+import zlib
+
+import pytest
+
+from trivy_tpu.analyzer.binary import (GoBinaryAnalyzer,
+                                       RustBinaryAnalyzer,
+                                       GO_BUILDINF_MAGIC)
+from trivy_tpu.analyzer.jar import JarAnalyzer
+from trivy_tpu.analyzer.language import (DotNetDepsAnalyzer,
+                                         NugetLockAnalyzer)
+from trivy_tpu.analyzer.pkgfiles import (GemspecAnalyzer,
+                                         NodePkgAnalyzer,
+                                         PythonPkgAnalyzer)
+
+
+def _zip_bytes(entries: dict) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for name, data in entries.items():
+            zf.writestr(name, data)
+    return buf.getvalue()
+
+
+def _pkgs(result):
+    assert result is not None and result.applications
+    return {(p.name, p.version)
+            for p in result.applications[0].libraries}
+
+
+class TestJar:
+    def test_pom_properties(self):
+        jar = _zip_bytes({
+            "META-INF/maven/org.springframework/spring-core/"
+            "pom.properties":
+                "groupId=org.springframework\n"
+                "artifactId=spring-core\nversion=5.3.14\n",
+            "org/springframework/Some.class": b"\xca\xfe\xba\xbe",
+        })
+        r = JarAnalyzer().analyze("app/spring-core-5.3.14.jar", jar)
+        assert _pkgs(r) == {("org.springframework:spring-core",
+                             "5.3.14")}
+
+    def test_manifest_fallback(self):
+        jar = _zip_bytes({
+            "META-INF/MANIFEST.MF":
+                "Manifest-Version: 1.0\n"
+                "Implementation-Title: guava\n"
+                "Implementation-Version: 31.1-jre\n",
+        })
+        r = JarAnalyzer().analyze("libs/guava.jar", jar)
+        assert _pkgs(r) == {("guava", "31.1-jre")}
+
+    def test_filename_fallback(self):
+        jar = _zip_bytes({"x/y.class": b""})
+        r = JarAnalyzer().analyze("libs/log4j-core-2.14.1.jar", jar)
+        assert _pkgs(r) == {("log4j-core", "2.14.1")}
+
+    def test_shaded_fat_jar(self):
+        inner = _zip_bytes({
+            "META-INF/maven/com.fasterxml.jackson.core/"
+            "jackson-databind/pom.properties":
+                "groupId=com.fasterxml.jackson.core\n"
+                "artifactId=jackson-databind\nversion=2.9.1\n",
+        })
+        outer = _zip_bytes({
+            "META-INF/maven/com.example/app/pom.properties":
+                "groupId=com.example\nartifactId=app\n"
+                "version=1.0.0\n",
+            "BOOT-INF/lib/jackson-databind-2.9.1.jar": inner,
+        })
+        r = JarAnalyzer().analyze("app.jar", outer)
+        assert _pkgs(r) == {
+            ("com.example:app", "1.0.0"),
+            ("com.fasterxml.jackson.core:jackson-databind", "2.9.1")}
+
+    def test_not_a_zip(self):
+        r = JarAnalyzer().analyze("x.jar", b"not a zip")
+        assert not r.applications
+
+    def test_required(self):
+        a = JarAnalyzer()
+        assert a.required("a/b.jar") and a.required("x.war")
+        assert not a.required("x.zip")
+
+
+class TestPythonPkg:
+    METADATA = (b"Metadata-Version: 2.1\nName: Django\n"
+                b"Version: 4.0.2\nLicense: BSD-3-Clause\n"
+                b"\nDjango description body\nName: fake\n")
+
+    def test_wheel_metadata(self):
+        a = PythonPkgAnalyzer()
+        assert a.required(
+            "usr/lib/python3/dist-packages/"
+            "Django-4.0.2.dist-info/METADATA")
+        r = a.analyze("x/Django-4.0.2.dist-info/METADATA",
+                      self.METADATA)
+        assert _pkgs(r) == {("Django", "4.0.2")}
+        assert r.applications[0].libraries[0].licenses == \
+            ["BSD-3-Clause"]
+        assert r.applications[0].type == "python-pkg"
+
+    def test_body_headers_not_parsed(self):
+        r = PythonPkgAnalyzer().analyze(
+            "x.egg-info/PKG-INFO", self.METADATA)
+        # the "Name: fake" after the blank line is body text
+        assert _pkgs(r) == {("Django", "4.0.2")}
+
+
+class TestNodePkg:
+    def test_package_json(self):
+        a = NodePkgAnalyzer()
+        assert a.required("app/node_modules/express/package.json")
+        r = a.analyze("node_modules/express/package.json",
+                      json.dumps({"name": "express",
+                                  "version": "4.17.3",
+                                  "license": "MIT"}).encode())
+        assert _pkgs(r) == {("express", "4.17.3")}
+        assert r.applications[0].libraries[0].licenses == ["MIT"]
+
+    def test_license_object_form(self):
+        r = NodePkgAnalyzer().analyze(
+            "p/package.json",
+            json.dumps({"name": "x", "version": "1.0.0",
+                        "license": {"type": "ISC"}}).encode())
+        assert r.applications[0].libraries[0].licenses == ["ISC"]
+
+    def test_no_version_skipped(self):
+        r = NodePkgAnalyzer().analyze(
+            "p/package.json", json.dumps({"name": "app"}).encode())
+        assert not r.applications
+
+
+class TestGemspec:
+    GEMSPEC = b"""# -*- encoding: utf-8 -*-
+Gem::Specification.new do |s|
+  s.name = "rake".freeze
+  s.version = "13.0.6"
+  s.licenses = ["MIT".freeze]
+end
+"""
+
+    def test_parse(self):
+        a = GemspecAnalyzer()
+        assert a.required(
+            "usr/lib/ruby/gems/3.1.0/specifications/"
+            "rake-13.0.6.gemspec")
+        assert not a.required("rake.gemspec")
+        r = a.analyze("specifications/rake-13.0.6.gemspec",
+                      self.GEMSPEC)
+        assert _pkgs(r) == {("rake", "13.0.6")}
+        assert r.applications[0].libraries[0].licenses == ["MIT"]
+
+
+def _go_binary(mod_text: str) -> bytes:
+    """ELF-magic + Go ≥1.18 inline buildinfo layout."""
+    sentinel_mod = ("0" * 16 + mod_text + "0" * 16).encode()
+
+    def var_string(b: bytes) -> bytes:
+        out = b""
+        n = len(b)
+        while True:
+            out += bytes([n & 0x7F | (0x80 if n > 0x7F else 0)])
+            n >>= 7
+            if not n:
+                break
+        return out + b
+
+    blob = GO_BUILDINF_MAGIC
+    blob += b"\x08"          # ptr size
+    blob += b"\x02"          # flags: inline strings
+    blob += b"\x00" * (32 - len(blob))
+    blob += var_string(b"go1.19.5")
+    blob += var_string(sentinel_mod)
+    return b"\x7fELF" + b"\x00" * 60 + blob + b"\x00" * 32
+
+
+class TestGoBinary:
+    MOD = ("path\tgithub.com/example/app\n"
+           "mod\tgithub.com/example/app\tv1.0.0\t\n"
+           "dep\tgithub.com/gin-gonic/gin\tv1.7.7\th1:abc=\n"
+           "dep\tgolang.org/x/crypto\tv0.0.0-20220112\th1:def=\n")
+
+    def test_parse(self):
+        r = GoBinaryAnalyzer().analyze("usr/bin/app",
+                                       _go_binary(self.MOD))
+        pkgs = _pkgs(r)
+        assert ("github.com/gin-gonic/gin", "1.7.7") in pkgs
+        assert ("golang.org/x/crypto", "0.0.0-20220112") in pkgs
+        assert r.applications[0].type == "gobinary"
+
+    def test_replacement_line_wins(self):
+        """review: '=>' lines replace the preceding dep."""
+        mod = ("path\tapp\nmod\tapp\tv1.0.0\t\n"
+               "dep\tgolang.org/x/text\tv0.3.0\th1:a=\n"
+               "=>\tgolang.org/x/text\tv0.3.8\th1:b=\n")
+        r = GoBinaryAnalyzer().analyze("usr/bin/app",
+                                       _go_binary(mod))
+        assert ("golang.org/x/text", "0.3.8") in _pkgs(r)
+        assert ("golang.org/x/text", "0.3.0") not in _pkgs(r)
+
+    def test_corrupt_jar_entry_does_not_abort(self):
+        """review: bad CRC in one entry must not crash the scan."""
+        jar = bytearray(_zip_bytes(
+            {"META-INF/MANIFEST.MF":
+             "Implementation-Title: x\nImplementation-Version: 1\n"}))
+        # flip a payload byte to break the CRC
+        jar[40] ^= 0xFF
+        r = JarAnalyzer().analyze("libs/broken-1.0.jar", bytes(jar))
+        # falls back to the filename identity instead of crashing
+        assert _pkgs(r) == {("broken", "1.0")}
+
+    def test_non_go_binary_skipped(self):
+        r = GoBinaryAnalyzer().analyze(
+            "usr/bin/cat", b"\x7fELF" + b"\x00" * 100)
+        assert not r.applications
+
+    def test_non_binary_skipped(self):
+        r = GoBinaryAnalyzer().analyze("README", b"just text")
+        assert not r.applications
+
+    def test_required_gating(self):
+        a = GoBinaryAnalyzer()
+        assert a.required("usr/bin/app", 10000)
+        assert a.required("app.exe", 10000)
+        assert not a.required("app.py", 10000)
+        assert not a.required("usr/bin/app", 10)
+
+
+class TestRustBinary:
+    def test_parse(self):
+        audit = {"packages": [
+            {"name": "serde", "version": "1.0.130"},
+            {"name": "cc", "version": "1.0.0", "kind": "build"},
+        ]}
+        blob = (b"\x7fELF" + b"\x00" * 32 + b".dep-v0" +
+                zlib.compress(json.dumps(audit).encode()) +
+                b"\x00" * 16)
+        r = RustBinaryAnalyzer().analyze("usr/bin/rustapp", blob)
+        assert _pkgs(r) == {("serde", "1.0.130")}   # build dep skipped
+
+    def test_no_audit_section(self):
+        r = RustBinaryAnalyzer().analyze(
+            "usr/bin/x", b"\x7fELF" + b"\x00" * 64)
+        assert not r.applications
+
+
+class TestNuget:
+    def test_lock(self):
+        doc = {"version": 1, "dependencies": {
+            "net6.0": {
+                "Newtonsoft.Json": {"type": "Direct",
+                                    "resolved": "13.0.1"},
+                "System.Text.Json": {"type": "Transitive",
+                                     "resolved": "6.0.2"},
+            }}}
+        r = NugetLockAnalyzer().analyze(
+            "proj/packages.lock.json", json.dumps(doc).encode())
+        pkgs = {p.name: p for p in r.applications[0].libraries}
+        assert pkgs["Newtonsoft.Json"].version == "13.0.1"
+        assert not pkgs["Newtonsoft.Json"].indirect
+        assert pkgs["System.Text.Json"].indirect
+
+    def test_packages_config(self):
+        xml = (b'<?xml version="1.0"?><packages>'
+               b'<package id="NUnit" version="3.13.2" />'
+               b'<package id="DevTool" version="1.0" '
+               b'developmentDependency="true" /></packages>')
+        r = NugetLockAnalyzer().analyze("packages.config", xml)
+        assert _pkgs(r) == {("NUnit", "3.13.2")}
+
+    def test_deps_json(self):
+        doc = {"libraries": {
+            "MyApp/1.0.0": {"type": "project"},
+            "Serilog/2.10.0": {"type": "package"},
+        }}
+        r = DotNetDepsAnalyzer().analyze(
+            "app/MyApp.deps.json", json.dumps(doc).encode())
+        assert _pkgs(r) == {("Serilog", "2.10.0")}
+
+
+class TestImageAggregation:
+    def test_python_pkgs_aggregate_across_layers(self, tmp_path):
+        """Installed-package types aggregate into one app per type
+        (applier _AGGREGATE_TYPES), so an image scan reports them
+        under a single 'Python' target."""
+        from tests.test_e2e_image import make_image_tar, run_cli
+        img = make_image_tar(tmp_path, [
+            {"usr/lib/python3/dist-packages/"
+             "Django-4.0.2.dist-info/METADATA":
+                 TestPythonPkg.METADATA},
+            {"usr/lib/python3/dist-packages/"
+             "requests-2.27.0.dist-info/METADATA":
+                 b"Name: requests\nVersion: 2.27.0\n\n"},
+        ])
+        out = tmp_path / "r.json"
+        code, _ = run_cli([
+            "image", "--input", img, "--format", "json",
+            "--list-all-pkgs", "--security-checks", "vuln",
+            "--output", str(out), "--backend", "cpu",
+            "--no-cache", "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        report = json.loads(out.read_text())
+        python_results = [r for r in report["Results"]
+                          if r.get("Type") == "python-pkg"]
+        assert len(python_results) == 1
+        assert python_results[0]["Target"] == "Python"
+        names = {p["Name"] for p in python_results[0]["Packages"]}
+        assert names == {"Django", "requests"}

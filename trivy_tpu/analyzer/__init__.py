@@ -21,6 +21,9 @@ from . import language  # noqa: F401
 from . import rpm  # noqa: F401
 from . import config  # noqa: F401
 from . import licensing  # noqa: F401
+from . import pkgfiles  # noqa: F401
+from . import jar  # noqa: F401
+from . import binary  # noqa: F401
 
 __all__ = ["Analyzer", "AnalysisResult", "AnalyzerGroup",
            "register_analyzer", "registered_analyzers"]

@@ -533,3 +533,85 @@ class GoModAnalyzer(Analyzer):
         return _app("gomod", path,
                     [Package(name=n, version=v)
                      for n, v in mods.items()])
+
+
+@register_analyzer
+class NugetLockAnalyzer(Analyzer):
+    """packages.lock.json (reference: go-dep-parser nuget/lock):
+    per-framework dependency maps with resolved versions."""
+
+    type = "nuget"
+    version = 1
+
+    def required(self, path, size=None):
+        return posixpath.basename(path) in ("packages.lock.json",
+                                            "packages.config")
+
+    def analyze(self, path, content):
+        if path.endswith("packages.config"):
+            return self._analyze_config(path, content)
+        try:
+            doc = json.loads(content)
+        except ValueError:
+            return None
+        pkgs: dict = {}
+        for framework in (doc.get("dependencies") or {}).values():
+            for name, meta in (framework or {}).items():
+                if not isinstance(meta, dict):
+                    continue
+                version = meta.get("resolved", "")
+                if not version:
+                    continue
+                indirect = meta.get("type", "") == "Transitive"
+                key = (name, version)
+                if key not in pkgs:
+                    pkgs[key] = _lib(name, version, indirect)
+        return _app("nuget", path, list(pkgs.values()))
+
+    def _analyze_config(self, path, content):
+        """packages.config XML (legacy NuGet): <package id= version=>;
+        development-only dependencies are skipped."""
+        import xml.etree.ElementTree as ET
+        try:
+            root = ET.fromstring(content)
+        except ET.ParseError:
+            return None
+        pkgs = []
+        for el in root.iter("package"):
+            name = el.get("id") or ""
+            version = el.get("version") or ""
+            if not name or not version:
+                continue
+            if (el.get("developmentDependency") or "").lower() == \
+                    "true":
+                continue
+            pkgs.append(_lib(name, version))
+        return _app("nuget", path, pkgs)
+
+
+@register_analyzer
+class DotNetDepsAnalyzer(Analyzer):
+    """*.deps.json (reference: go-dep-parser dotnet/core_deps):
+    published .NET runtime dependency manifests."""
+
+    type = "dotnet-core"
+    version = 1
+
+    def required(self, path, size=None):
+        return path.endswith(".deps.json")
+
+    def analyze(self, path, content):
+        try:
+            doc = json.loads(content)
+        except ValueError:
+            return None
+        libraries = doc.get("libraries") or {}
+        pkgs = []
+        for key, meta in libraries.items():
+            if not isinstance(meta, dict) or \
+                    meta.get("type") != "package":
+                continue
+            name, _, version = key.partition("/")
+            if name and version:
+                pkgs.append(_lib(name, version))
+        return _app("dotnet-core", path, pkgs)

@@ -25,11 +25,17 @@ from .analyzer import AnalysisResult, Analyzer, register_analyzer
 
 LICENSE_ANALYZER_TYPES = ("license-file", "dpkg-license")
 
+# matched on path-segment boundaries: "usr/lib" skips usr/lib/... but
+# not usr/libexec/...
 _SKIP_DIRS = (
-    "node_modules/", "usr/share/doc/", "usr/lib", "usr/local/include",
-    "usr/include", "usr/lib/python", "usr/local/go", "opt/yarn",
-    "usr/lib/gems", "usr/src/wordpress",
+    "node_modules", "usr/share/doc", "usr/lib", "usr/local/include",
+    "usr/include", "usr/local/go", "opt/yarn", "usr/src/wordpress",
 )
+
+
+def _in_skip_dir(path: str) -> bool:
+    padded = "/" + path
+    return any(f"/{d}/" in padded for d in _SKIP_DIRS)
 
 _ACCEPTED_EXTENSIONS = (
     ".asp", ".aspx", ".bas", ".bat", ".b", ".c", ".cue", ".cgi",
@@ -58,7 +64,7 @@ class LicenseFileAnalyzer(Analyzer):
     def required(self, path: str, size: Optional[int] = None) -> bool:
         if size is not None and size > MAX_LICENSE_SIZE:
             return False
-        if any(skip in path for skip in _SKIP_DIRS):
+        if _in_skip_dir(path):
             return False
         if _is_license_filename(path):
             return True

@@ -19,6 +19,7 @@ from __future__ import annotations
 import re
 
 from ..types import LicenseFile, LicenseFinding
+from .normalize import normalize
 
 # max bytes inspected for header classification (code files)
 HEAD_SIZE = 4096
@@ -105,7 +106,8 @@ def classify_findings(content: bytes) -> list:
                             flags=re.IGNORECASE)[0].strip("()")
             if name and name not in seen:
                 seen.add(name)
-                families.add(_FAMILY.get(name, name))
+                canonical = normalize(name)
+                families.add(_FAMILY.get(canonical, canonical))
                 findings.append(LicenseFinding(
                     name=name, confidence=1.0,
                     link=_AVD_LINK.format(name)))

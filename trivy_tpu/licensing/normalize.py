@@ -17,7 +17,12 @@ _MAPPING = {
     "GPLV3+": "GPL-3.0", "GPL-3": "GPL-3.0",
     "GPL-3.0-ONLY": "GPL-3.0", "GPL3+": "GPL-3.0",
     "GPL-3+": "GPL-3.0", "GPL-3.0-OR-LATER": "GPL-3.0",
+    # the reference maps the GPL-3 bison variant onto the GPL-2.0
+    # exception id (normalize.go:31) — kept verbatim for parity; both
+    # land in the restricted category either way. The spaced forms
+    # are what dpkg copyright files actually contain.
     "GPL-3+-WITH-BISON-EXCEPTION": "GPL-2.0-with-bison-exception",
+    "GPL-3+ WITH BISON EXCEPTION": "GPL-2.0-with-bison-exception",
     "GPL": "GPL-3.0",
     # LGPL
     "LGPL2": "LGPL-2.0", "LGPL 2": "LGPL-2.0",
