@@ -1,0 +1,217 @@
+"""Post-scan hooks, Red Hat modularity gating, arch gating, and the
+ignore-policy hook (VERDICT rows 25/31/32)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.detect.ospkg.drivers import (DRIVERS,
+                                            add_modular_namespace)
+from trivy_tpu.db import AdvisoryStore
+from trivy_tpu.types.artifact import Package
+
+
+class TestModularity:
+    def test_add_modular_namespace(self):
+        assert add_modular_namespace(
+            "npm", "nodejs:12:8030020201124152102:229f0a1c") == \
+            "nodejs:12::npm"
+        assert add_modular_namespace("bash", "") == "bash"
+        assert add_modular_namespace("x", "stream") == "x"
+
+    def test_modular_package_lookup(self):
+        """A modular rpm only matches advisories keyed under its
+        module stream (redhat.go:127)."""
+        store = AdvisoryStore()
+        store.put_advisory("Red Hat", "nodejs:12::npm",
+                           "CVE-2021-0001",
+                           {"FixedVersion": "6.14.11"})
+        store.put_advisory("Red Hat", "npm", "CVE-2021-0002",
+                           {"FixedVersion": "6.14.11"})
+        driver = DRIVERS["redhat"]
+        modular = Package(
+            name="npm", version="6.14.10", release="1.module+el8",
+            arch="x86_64", src_name="npm", src_version="6.14.10",
+            src_release="1.module+el8",
+            modularity_label="nodejs:12:8030020201124152102:229f")
+        vulns = driver.detect(store, "8.3", None, [modular])
+        assert [v.vulnerability_id for v in vulns] == \
+            ["CVE-2021-0001"]
+        plain = Package(
+            name="npm", version="6.14.10", release="1.el8",
+            arch="x86_64", src_name="npm", src_version="6.14.10",
+            src_release="1.el8")
+        vulns = driver.detect(store, "8.3", None, [plain])
+        assert [v.vulnerability_id for v in vulns] == \
+            ["CVE-2021-0002"]
+
+
+class TestArchGating:
+    def test_arch_list_filters(self):
+        store = AdvisoryStore()
+        store.put_advisory("Red Hat", "kernel", "CVE-2022-1",
+                           {"FixedVersion": "5.0",
+                            "Arches": ["aarch64"]})
+        store.put_advisory("Red Hat", "kernel", "CVE-2022-2",
+                           {"FixedVersion": "5.0",
+                            "Arches": ["x86_64"]})
+        store.put_advisory("Red Hat", "kernel", "CVE-2022-3",
+                           {"FixedVersion": "5.0"})
+        driver = DRIVERS["redhat"]
+        pkg = Package(name="kernel", version="4.18.0", arch="x86_64",
+                      src_name="kernel", src_version="4.18.0")
+        ids = sorted(v.vulnerability_id for v in
+                     driver.detect(store, "8.3", None, [pkg]))
+        assert ids == ["CVE-2022-2", "CVE-2022-3"]
+        noarch = Package(name="kernel", version="4.18.0",
+                         arch="noarch", src_name="kernel",
+                         src_version="4.18.0")
+        ids = sorted(v.vulnerability_id for v in
+                     driver.detect(store, "8.3", None, [noarch]))
+        assert ids == ["CVE-2022-1", "CVE-2022-2", "CVE-2022-3"]
+
+
+class TestArchGatingPipeline:
+    def test_real_scan_path_gates_arch(self):
+        """The gate must run in LocalScanner._vuln_jobs (both store
+        paths), not just the test-facing Driver.detect loop
+        (review finding r1)."""
+        from trivy_tpu.artifact.cache import MemoryCache
+        from trivy_tpu.db import CompiledDB
+        from trivy_tpu.scan.local import LocalScanner, ScanTarget
+        from trivy_tpu.types import ScanOptions
+        from trivy_tpu.types.artifact import (OS, BlobInfo,
+                                              PackageInfo)
+        store = AdvisoryStore()
+        store.put_advisory("Red Hat", "kernel", "CVE-A",
+                           {"FixedVersion": "5.0",
+                            "Arches": ["aarch64"]})
+        store.put_advisory("Red Hat", "kernel", "CVE-B",
+                           {"FixedVersion": "5.0",
+                            "Arches": ["x86_64"]})
+        cache = MemoryCache()
+        cache.put_blob("sha256:b", BlobInfo(
+            os=OS(family="redhat", name="8.3"),
+            package_infos=[PackageInfo(packages=[
+                Package(name="kernel", version="4.18.0",
+                        arch="x86_64", src_name="kernel",
+                        src_version="4.18.0")])]))
+        for st in (store, CompiledDB.compile(store)):
+            results, _ = LocalScanner(cache, st).scan(
+                ScanTarget(name="t", artifact_id="a",
+                           blob_ids=["sha256:b"]),
+                ScanOptions(security_checks=["vuln"],
+                            backend="cpu"))
+            ids = sorted(v.vulnerability_id for r in results
+                         for v in r.vulnerabilities)
+            assert ids == ["CVE-B"]
+
+
+class TestPostScanHooks:
+    def test_hook_rewrites_results(self):
+        from trivy_tpu.scan.post import (deregister_post_scanner,
+                                         post_scan,
+                                         post_scanner_versions,
+                                         register_post_scanner)
+
+        class Doubler:
+            name = "test-hook"
+            version = 2
+
+            def post_scan(self, results):
+                for r in results:
+                    r.target = r.target + "!"
+                return results
+
+        register_post_scanner(Doubler())
+        try:
+            assert post_scanner_versions() == {"test-hook": 2}
+            from trivy_tpu.types import Result
+            out = post_scan([Result(target="t")])
+            assert out[0].target == "t!"
+        finally:
+            deregister_post_scanner("test-hook")
+
+    def test_hook_runs_in_scan(self, tmp_path):
+        """LocalScanner.finish routes through the hook chain
+        (ref local/scan.go:170-174)."""
+        from trivy_tpu.artifact.cache import MemoryCache
+        from trivy_tpu.scan.local import LocalScanner, ScanTarget
+        from trivy_tpu.scan.post import (deregister_post_scanner,
+                                         register_post_scanner)
+        from trivy_tpu.types import ScanOptions
+        from trivy_tpu.types.artifact import (OS, BlobInfo, Package,
+                                              PackageInfo)
+
+        seen = []
+
+        class Spy:
+            name = "spy"
+            version = 1
+
+            def post_scan(self, results):
+                seen.append(len(results))
+                return results
+
+        cache = MemoryCache()
+        cache.put_blob("sha256:b", BlobInfo(
+            os=OS(family="alpine", name="3.16.0"),
+            package_infos=[PackageInfo(packages=[
+                Package(name="musl", version="1.2.2")])]))
+        register_post_scanner(Spy())
+        try:
+            LocalScanner(cache).scan(
+                ScanTarget(name="t", artifact_id="a",
+                           blob_ids=["sha256:b"]),
+                ScanOptions(security_checks=["vuln"],
+                            backend="cpu"))
+        finally:
+            deregister_post_scanner("spy")
+        assert seen
+
+
+class TestIgnorePolicy:
+    def test_policy_filters_vulns_and_misconfs(self, tmp_path):
+        policy = tmp_path / "policy.py"
+        policy.write_text(
+            "def ignore(finding):\n"
+            "    return finding.get('VulnerabilityID') == 'CVE-1' "
+            "or finding.get('ID') == 'DS002'\n")
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        d = tmp_path / "scan"
+        d.mkdir()
+        (d / "Dockerfile").write_bytes(
+            b"FROM alpine:latest\nUSER root\n")
+        out = tmp_path / "r.json"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main([
+                "fs", str(d), "--security-checks", "config",
+                "--ignore-policy", str(policy),
+                "--format", "json", "--output", str(out),
+                "--no-cache", "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        report = json.loads(out.read_text())
+        ids = {m["ID"] for r in report["Results"]
+               for m in r.get("Misconfigurations", [])}
+        assert "DS002" not in ids       # policy-ignored
+        assert "DS001" in ids
+
+    def test_bad_policy_file(self, tmp_path):
+        policy = tmp_path / "policy.py"
+        policy.write_text("x = 1\n")    # no ignore()
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        d = tmp_path / "scan"
+        d.mkdir()
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(["fs", str(d), "--ignore-policy",
+                         str(policy), "--no-cache",
+                         "--cache-dir", str(tmp_path / "c")])
+        assert code == 1

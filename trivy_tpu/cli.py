@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
                        os.path.expanduser("~"), ".cache", "trivy-tpu"))
     p.add_argument("--quiet", "-q", action="store_true")
     p.add_argument("--debug", "-d", action="store_true")
+    p.add_argument("--config", "-c", default="",
+                   help="config file (default: trivy.yaml when "
+                   "present); flags also bind TRIVY_* env vars")
     sub = p.add_subparsers(dest="command")
 
     def scan_flags(sp):
@@ -61,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="include passed/excepted misconfig "
                         "checks in the results")
         sp.add_argument("--ignorefile", default=".trivyignore")
+        sp.add_argument("--ignore-policy", default="",
+                        help="Python policy file defining "
+                        "ignore(finding) (the Rego ignore-policy "
+                        "analog)")
         sp.add_argument("--exit-code", type=int, default=0)
         sp.add_argument("--skip-dirs", default="")
         sp.add_argument("--skip-files", default="")
@@ -77,6 +84,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(path prefix from 'trivy-tpu db build')")
         sp.add_argument("--secret-config", default="trivy-secret.yaml")
         sp.add_argument("--no-cache", action="store_true")
+        sp.add_argument("--timeout", default="5m0s",
+                        help="scan timeout (e.g. 5m0s)")
+        sp.add_argument("--config", "-c", default="",
+                        help="config file (default: trivy.yaml)")
         sp.add_argument("--server", default="",
                         help="server URL for client/server mode "
                         "(detection runs remotely; no local DB)")
@@ -142,7 +153,29 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    from .flag import (ScanTimeout, apply_external_defaults,
+                       parse_duration, scan_deadline)
+    parser = build_parser()
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
+    apply_external_defaults(parser, raw_argv)
+    args = parser.parse_args(argv)
+    timeout_s = 0.0
+    if getattr(args, "timeout", ""):
+        try:
+            timeout_s = parse_duration(args.timeout)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    try:
+        with scan_deadline(timeout_s):
+            return _dispatch(args)
+    except ScanTimeout:
+        print(f"error: scan timeout of {args.timeout} exceeded "
+              "(raise with --timeout)", file=sys.stderr)
+        return 1
+
+
+def _dispatch(args) -> int:
     if args.command in (None, "version"):
         print(f"trivy-tpu {__version__}")
         return 0
@@ -287,12 +320,25 @@ def _scan_options(args) -> ScanOptions:
 
 
 def _finish(args, report: Report) -> int:
-    results = filter_results(
-        report.results, _severities(args.severity),
-        ignore_unfixed=args.ignore_unfixed,
-        ignored_ids=load_ignore_file(args.ignorefile),
-        include_non_failures=getattr(args, "include_non_failures",
-                                     False))
+    from .scan.filter import load_ignore_policy
+    try:
+        policy = load_ignore_policy(
+            getattr(args, "ignore_policy", ""))
+        results = filter_results(
+            report.results, _severities(args.severity),
+            ignore_unfixed=args.ignore_unfixed,
+            ignored_ids=load_ignore_file(args.ignorefile),
+            policy=policy,
+            include_non_failures=getattr(
+                args, "include_non_failures", False))
+    except Exception as e:              # noqa: BLE001 — a broken
+        # user policy (bad import, raise inside ignore()) must fail
+        # cleanly, like the reference's Rego eval errors
+        if getattr(args, "ignore_policy", ""):
+            print(f"error: ignore policy failed: {e!r}",
+                  file=sys.stderr)
+            return 1
+        raise
     report.results = [r for r in results if not r.empty()]
     out = open(args.output, "w") if args.output else sys.stdout
     try:

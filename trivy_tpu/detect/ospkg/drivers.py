@@ -68,6 +68,8 @@ class Driver:
                 log.debug("installed version parse error: %s", e)
                 continue
             for adv in store.get(bucket, self.src_name(pkg)):
+                if not arch_match(pkg, adv):
+                    continue
                 if not self._is_vulnerable(comparer, installed_key,
                                            adv):
                     continue
@@ -258,16 +260,44 @@ OPENSUSE_EOL = {
 }
 
 
+def add_modular_namespace(name: str, label: str) -> str:
+    """redhat.go:240-251: "npm" + "nodejs:12:8030...:229f..." →
+    "nodejs:12::npm" — module streams get their own advisory keys.
+    Accepts short "name:stream" labels too (the reference needs two
+    colons and drops those; real labels have four fields either way).
+    """
+    parts = label.split(":")
+    if len(parts) >= 2 and parts[0] and parts[1]:
+        return f"{parts[0]}:{parts[1]}::{name}"
+    return name
+
+
+def arch_match(pkg, adv) -> bool:
+    """Per-advisory arch lists gate matches; "noarch" packages match
+    any (redhat.go:150-155)."""
+    return not adv.arches or pkg.arch == "noarch" or \
+        pkg.arch in adv.arches
+
+
 class _RedHat(Driver):
     """Red Hat / CentOS (reference: pkg/detector/ospkg/redhat).
 
-    Partial: advisories come from the flat 'Red Hat' bucket keyed by
-    source package name; the reference additionally filters by CPE
-    content sets from buildinfo and handles modularity labels — those
-    refinements layer on when the Red Hat CPE table lands."""
+    Modular packages look up under their module stream namespace
+    (redhat.go:127) and per-advisory arch lists gate matches
+    (redhat.go:150-155). Remaining simplification: advisories come
+    from the flat 'Red Hat' bucket; the reference additionally
+    narrows candidates by CPE content sets from buildinfo —
+    our name-keyed store returns the superset, which the arch +
+    version comparisons then filter."""
 
     def bucket(self, os_ver: str, repo) -> str:
         return "Red Hat"
+
+    def src_name(self, pkg) -> str:
+        name = pkg.src_name or pkg.name
+        if pkg.modularity_label:
+            return add_modular_namespace(name, pkg.modularity_label)
+        return name
 
     def eol_key(self, os_ver: str) -> str:
         # "8.4.2105" → "8" (redhat.go:212-214)

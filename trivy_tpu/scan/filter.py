@@ -14,6 +14,25 @@ from typing import Callable, Optional
 from ..types import Severity
 
 
+def load_ignore_policy(path: str):
+    """--ignore-policy: a Python file defining ``ignore(finding) ->
+    bool`` over the finding's JSON dict (the analog of the
+    reference's Rego ``data.trivy.ignore`` query, filter.go:162-219;
+    Python predicate instead of OPA — same contract, same hook)."""
+    if not path:
+        return None
+    import types as _types
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    mod = _types.ModuleType("trivy_ignore_policy")
+    exec(compile(source, path, "exec"), mod.__dict__)
+    fn = getattr(mod, "ignore", None)
+    if not callable(fn):
+        raise ValueError(
+            f"ignore policy {path} must define ignore(finding)")
+    return lambda finding: bool(fn(finding.to_dict()))
+
+
 def load_ignore_file(path: str = ".trivyignore") -> list:
     if not path or not os.path.exists(path):
         return []
@@ -41,7 +60,7 @@ def filter_results(results: list, severities: list,
             policy)
         r.misconf_summary, r.misconfigurations = _filter_misconfs(
             r.misconfigurations, sev_names, ignored,
-            include_non_failures)
+            include_non_failures, policy)
         r.secrets = [s for s in r.secrets
                      if s.severity in sev_names
                      and s.rule_id not in ignored]
@@ -52,7 +71,8 @@ def filter_results(results: list, severities: list,
 
 
 def _filter_misconfs(misconfs: list, sev_names: set, ignored: set,
-                     include_non_failures: bool) -> tuple:
+                     include_non_failures: bool,
+                     policy=None) -> tuple:
     """filterMisconfigurations (filter.go:124-154): severity/id
     filter, PASS/EXCEPTION dropped unless requested, and a
     pass/fail/exception summary."""
@@ -64,6 +84,8 @@ def _filter_misconfs(misconfs: list, sev_names: set, ignored: set,
             continue
         if getattr(m, "id", "") in ignored or \
                 getattr(m, "avd_id", "") in ignored:
+            continue
+        if policy is not None and policy(m):
             continue
         status = getattr(m, "status", "")
         if status == "FAIL":
