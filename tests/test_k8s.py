@@ -1,0 +1,201 @@
+"""K8s scanning tests (mirrors pkg/k8s scanner/report behavior over
+the manifest-enumerator seam)."""
+
+import json
+
+import pytest
+
+from trivy_tpu.k8s import Artifact, K8sScanner, ManifestClient
+
+DEPLOYMENT = """apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: prod
+spec:
+  template:
+    spec:
+      containers:
+        - name: app
+          image: test/alpine:3.9
+          securityContext:
+            privileged: true
+"""
+
+RBAC = """apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: reader
+  namespace: prod
+rules:
+  - apiGroups: [""]
+    resources: ["pods"]
+    verbs: ["get"]
+"""
+
+CRONJOB = """apiVersion: batch/v1
+kind: CronJob
+metadata:
+  name: nightly
+spec:
+  jobTemplate:
+    spec:
+      template:
+        spec:
+          containers:
+            - name: task
+              image: test/task:1.0
+"""
+
+
+@pytest.fixture()
+def manifests(tmp_path):
+    d = tmp_path / "cluster"
+    d.mkdir()
+    (d / "deploy.yaml").write_text(DEPLOYMENT)
+    (d / "rbac.yaml").write_text(RBAC)
+    (d / "cron.yaml").write_text(CRONJOB)
+    return d
+
+
+class TestManifestClient:
+    def test_enumerates_artifacts(self, manifests):
+        arts = ManifestClient(str(manifests)).artifacts()
+        by_kind = {a.kind: a for a in arts}
+        assert set(by_kind) == {"Deployment", "Role", "CronJob"}
+        assert by_kind["Deployment"].images == ["test/alpine:3.9"]
+        assert by_kind["Deployment"].namespace == "prod"
+        assert by_kind["CronJob"].images == ["test/task:1.0"]
+        assert by_kind["Role"].images == []
+
+    def test_multi_doc_file(self, tmp_path):
+        f = tmp_path / "all.yaml"
+        f.write_text(DEPLOYMENT + "---\n" + RBAC)
+        arts = ManifestClient(str(f)).artifacts()
+        assert len(arts) == 2
+
+
+class TestK8sScan:
+    def test_misconfig_scan(self, manifests):
+        scanner = K8sScanner(security_checks=["config"],
+                             backend="cpu")
+        report = scanner.scan(ManifestClient(str(manifests)))
+        by_name = {r.name: r for r in report.misconfigurations}
+        deploy = by_name["web"]
+        ids = {m.id for res in deploy.results
+               for m in res.misconfigurations
+               if m.status == "FAIL"}
+        assert "KSV017" in ids            # privileged
+        assert report.vulnerabilities == []
+
+    def test_image_fleet_batch(self, manifests, tmp_path):
+        """Workload images resolve from --images-dir and scan as ONE
+        fleet batch (the reference loops sequentially)."""
+        from tests.test_e2e_image import FIXTURE_DB, make_image_tar
+        from trivy_tpu.db import AdvisoryStore, load_fixtures
+
+        images = tmp_path / "images"
+        images.mkdir()
+        img = make_image_tar(tmp_path, [{
+            "etc/alpine-release": b"3.9.4\n",
+            "lib/apk/db/installed":
+                b"P:musl\nV:1.1.20-r4\no:musl\nL:MIT\n\n",
+        }])
+        import shutil
+        shutil.copy(img, images / "test_alpine_3.9.tar")
+
+        dbf = tmp_path / "db.yaml"
+        dbf.write_text(FIXTURE_DB)
+        store = AdvisoryStore()
+        load_fixtures([str(dbf)], store)
+
+        scanner = K8sScanner(store=store, backend="cpu",
+                             images_dir=str(images),
+                             security_checks=["vuln", "config"])
+        report = scanner.scan(ManifestClient(str(manifests)))
+        vulns = {r.name: r for r in report.vulnerabilities}
+        web = vulns["web"]
+        assert not web.error
+        ids = [v.vulnerability_id for res in web.results
+               for v in res.vulnerabilities]
+        assert "CVE-2019-14697" in ids
+        # the cronjob's image has no tarball → per-resource error
+        assert vulns["nightly"].error.startswith(
+            "image not resolvable")
+
+
+class TestCLI:
+    def _run(self, argv):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+
+    def test_summary_table(self, manifests, tmp_path):
+        code, out = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        assert "Summary Report for cluster" in out
+        assert "Deployment/web" in out
+        assert "Role/reader" in out
+
+    def test_json_report(self, manifests, tmp_path):
+        out_file = tmp_path / "r.json"
+        code, _ = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--format", "json",
+            "--output", str(out_file),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        doc = json.loads(out_file.read_text())
+        assert doc["ClusterName"] == "cluster"
+        kinds = {r["Kind"] for r in doc["Misconfigurations"]}
+        assert kinds == {"Deployment", "Role", "CronJob"}
+
+    def test_severity_filter_applies(self, manifests, tmp_path):
+        """k8s mode honors --severity like every other scan mode
+        (review finding r1)."""
+        code, _ = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--severity", "CRITICAL",
+            "--exit-code", "5",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0      # only HIGH/MEDIUM findings exist
+
+    def test_plugin_args_not_intercepted(self, tmp_path):
+        """`plugin run name --config x` forwards --config to the
+        plugin (review finding r2)."""
+        import os
+        src = tmp_path / "p"
+        src.mkdir()
+        (src / "plugin.yaml").write_text(
+            "name: echoer\nversion: 1\nplatforms:\n"
+            "  - selector: {os: linux}\n    uri: ./e.sh\n"
+            "    bin: ./e.sh\n")
+        (src / "e.sh").write_text("#!/bin/sh\nexit 9\n")
+        os.chmod(src / "e.sh", 0o755)
+        saved = dict(os.environ)
+        try:
+            os.environ["TRIVY_PLUGIN_DIR"] = str(tmp_path / "pd")
+            code, _ = self._run(["plugin", "install", str(src)])
+            assert code == 0
+            code, _ = self._run(
+                ["plugin", "run", "echoer", "--config",
+                 "/nonexistent.yaml"])
+            assert code == 9      # ran the plugin, no config error
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+
+    def test_exit_code(self, manifests, tmp_path):
+        code, _ = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--exit-code", "5",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 5

@@ -1,0 +1,159 @@
+"""Plugin (subprocess) + module (in-process extension) tests
+(mirrors pkg/plugin/plugin.go + pkg/module behavior)."""
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+PLUGIN_YAML = """name: hello
+version: 0.1.0
+usage: say hello
+platforms:
+  - selector:
+      os: linux
+    uri: ./hello.sh
+    bin: ./hello.sh
+"""
+
+HELLO_SH = "#!/bin/sh\necho hello from plugin $1\nexit 7\n"
+
+
+def _run(argv, env=None):
+    from trivy_tpu.cli import main
+    saved = dict(os.environ)
+    try:
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+@pytest.fixture()
+def plugin_env(tmp_path):
+    src = tmp_path / "hello-plugin"
+    src.mkdir()
+    (src / "plugin.yaml").write_text(PLUGIN_YAML)
+    (src / "hello.sh").write_text(HELLO_SH)
+    os.chmod(src / "hello.sh", 0o755)
+    env = {"TRIVY_PLUGIN_DIR": str(tmp_path / "plugins")}
+    return src, env
+
+
+class TestPlugin:
+    def test_install_list_info_run_uninstall(self, plugin_env):
+        src, env = plugin_env
+        code, out = _run(["plugin", "install", str(src)], env)
+        assert code == 0 and "installed plugin hello" in out
+
+        code, out = _run(["plugin", "list"], env)
+        assert code == 0 and out.startswith("hello\t0.1.0")
+
+        code, out = _run(["plugin", "info", "hello"], env)
+        assert "usage: say hello" in out
+
+        code, _ = _run(["plugin", "run", "hello", "world"], env)
+        assert code == 7          # plugin exit code propagates
+
+        code, out = _run(["plugin", "uninstall", "hello"], env)
+        assert code == 0
+        code, _ = _run(["plugin", "run", "hello"], env)
+        assert code == 1
+
+    def test_unknown_subcommand_dispatches_plugin(self, plugin_env):
+        """app.go:96: `trivy-tpu hello` runs the installed plugin."""
+        src, env = plugin_env
+        _run(["plugin", "install", str(src)], env)
+        code, _ = _run(["hello", "arg"], env)
+        assert code == 7
+
+    def test_install_from_archive(self, plugin_env, tmp_path):
+        import tarfile
+        src, env = plugin_env
+        arc = tmp_path / "hello.tar.gz"
+        with tarfile.open(arc, "w:gz") as tf:
+            tf.add(src / "plugin.yaml", arcname="plugin.yaml")
+            tf.add(src / "hello.sh", arcname="hello.sh")
+        code, out = _run(["plugin", "install", str(arc)], env)
+        assert code == 0
+        code, _ = _run(["plugin", "run", "hello"], env)
+        assert code == 7
+
+    def test_platform_mismatch(self, plugin_env, tmp_path):
+        src, env = plugin_env
+        (src / "plugin.yaml").write_text(
+            PLUGIN_YAML.replace("os: linux", "os: windows"))
+        _run(["plugin", "install", str(src)], env)
+        code, _ = _run(["plugin", "run", "hello"], env)
+        assert code == 1
+
+
+MODULE_PY = '''
+name = "env-flagger"
+version = 1
+api_version = 1
+is_analyzer = True
+is_post_scanner = True
+required_files = [r"\\\\.flag$"]
+
+
+def analyze(path, content):
+    return {"content": content.decode()}
+
+
+def post_scan(results):
+    for r in results:
+        r.target = "[module] " + r.target
+    return results
+'''
+
+
+class TestModule:
+    def test_module_analyzer_and_post_scanner(self, tmp_path):
+        mod_dir = tmp_path / "modules"
+        mod_dir.mkdir()
+        (mod_dir / "flagger.py").write_text(MODULE_PY)
+        scan_dir = tmp_path / "scan"
+        scan_dir.mkdir()
+        (scan_dir / "x.flag").write_text("hi")
+        out = tmp_path / "r.json"
+        env = {"TRIVY_MODULE_DIR": str(mod_dir)}
+        code, _ = _run(
+            ["fs", str(scan_dir), "--security-checks", "vuln",
+             "--list-all-pkgs", "--format", "json",
+             "--output", str(out),
+             "--no-cache", "--cache-dir", str(tmp_path / "c")],
+            env)
+        assert code == 0
+        report = json.loads(out.read_text())
+        # post-scanner rewrote targets
+        assert all(r["Target"].startswith("[module] ")
+                   for r in report.get("Results") or [])
+        # cleanup: deregister so other tests aren't affected
+        from trivy_tpu.analyzer.analyzer import _REGISTRY
+        from trivy_tpu.scan.post import deregister_post_scanner
+        deregister_post_scanner("env-flagger")
+        _REGISTRY[:] = [a for a in _REGISTRY
+                        if a.type != "module:env-flagger"]
+
+    def test_broken_module_skipped(self, tmp_path):
+        mod_dir = tmp_path / "modules"
+        mod_dir.mkdir()
+        (mod_dir / "bad.py").write_text("raise RuntimeError('boom')")
+        from trivy_tpu.module import Manager
+        assert Manager(str(mod_dir)).load() == []
+
+    def test_future_api_version_rejected(self, tmp_path):
+        mod_dir = tmp_path / "modules"
+        mod_dir.mkdir()
+        (mod_dir / "future.py").write_text(
+            "name = 'future'\napi_version = 99\n")
+        from trivy_tpu.module import Manager
+        assert Manager(str(mod_dir)).load() == []

@@ -120,6 +120,19 @@ def build_parser() -> argparse.ArgumentParser:
     sbom.add_argument("target")
     scan_flags(sbom)
 
+    k8s = sub.add_parser(
+        "k8s", help="scan kubernetes manifests/cluster state "
+        "(misconfigs on workloads; image vulns via --images-dir)")
+    k8s.add_argument("target",
+                     help="manifest file or directory of exported "
+                     "cluster manifests")
+    k8s.add_argument("--report", default="summary",
+                     choices=["summary", "all"])
+    k8s.add_argument("--images-dir", default="",
+                     help="directory of image tarballs named "
+                     "<ref with /:@ as _>.tar")
+    scan_flags(k8s)
+
     db = sub.add_parser("db", help="advisory DB operations")
     dbsub = db.add_subparsers(dest="db_command")
     build = dbsub.add_parser(
@@ -148,15 +161,40 @@ def build_parser() -> argparse.ArgumentParser:
                      "server hot-swaps when the file changes")
     srv.add_argument("--db-watch-interval", type=float, default=60.0)
 
+    plug = sub.add_parser("plugin", help="manage plugins")
+    plugsub = plug.add_subparsers(dest="plugin_command")
+    pi = plugsub.add_parser("install", help="install from a local "
+                            "directory or archive")
+    pi.add_argument("source")
+    pu = plugsub.add_parser("uninstall")
+    pu.add_argument("name")
+    plugsub.add_parser("list")
+    pinfo = plugsub.add_parser("info")
+    pinfo.add_argument("name")
+    prun = plugsub.add_parser("run")
+    prun.add_argument("name")
+    prun.add_argument("plugin_args", nargs=argparse.REMAINDER)
+
     sub.add_parser("version", help="print version")
     return p
+
+
+_KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "sbom",
+                   "k8s", "db", "server", "plugin", "version")
 
 
 def main(argv=None) -> int:
     from .flag import (ScanTimeout, apply_external_defaults,
                        parse_duration, scan_deadline)
-    parser = build_parser()
     raw_argv = list(sys.argv[1:] if argv is None else argv)
+    # unknown subcommands dispatch to installed plugins (app.go:96)
+    if raw_argv and not raw_argv[0].startswith("-") and \
+            raw_argv[0] not in _KNOWN_COMMANDS:
+        from .plugin import run_with_args
+        code = run_with_args(raw_argv[0], raw_argv[1:])
+        if code is not None:
+            return code
+    parser = build_parser()
     apply_external_defaults(parser, raw_argv)
     args = parser.parse_args(argv)
     timeout_s = 0.0
@@ -179,6 +217,10 @@ def _dispatch(args) -> int:
     if args.command in (None, "version"):
         print(f"trivy-tpu {__version__}")
         return 0
+    if args.command in ("image", "filesystem", "fs", "rootfs",
+                        "sbom", "k8s"):
+        from .module import Manager as _ModuleManager
+        _ModuleManager().load()
     if args.command in ("image",):
         return run_image(args)
     if args.command in ("filesystem", "fs", "rootfs"):
@@ -189,7 +231,95 @@ def _dispatch(args) -> int:
         return run_db(args)
     if args.command == "server":
         return run_server(args)
+    if args.command == "k8s":
+        return run_k8s(args)
+    if args.command == "plugin":
+        return run_plugin(args)
     return 2
+
+
+def run_plugin(args) -> int:
+    from . import plugin as plugin_mod
+    cmd = args.plugin_command
+    if cmd == "install":
+        try:
+            p = plugin_mod.install(args.source)
+        except (ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"installed plugin {p.name} {p.version}")
+        return 0
+    if cmd == "uninstall":
+        if not plugin_mod.uninstall(args.name):
+            print(f"error: no such plugin: {args.name}",
+                  file=sys.stderr)
+            return 1
+        print(f"uninstalled plugin {args.name}")
+        return 0
+    if cmd == "list":
+        for p in plugin_mod.load_all():
+            print(f"{p.name}\t{p.version}\t{p.usage or p.description}")
+        return 0
+    if cmd == "info":
+        p = plugin_mod.load(args.name)
+        if p is None:
+            print(f"error: no such plugin: {args.name}",
+                  file=sys.stderr)
+            return 1
+        print(f"name: {p.name}\nversion: {p.version}\n"
+              f"usage: {p.usage}\ndescription: {p.description}")
+        return 0
+    if cmd == "run":
+        code = plugin_mod.run_with_args(args.name, args.plugin_args)
+        if code is None:
+            print(f"error: no such plugin: {args.name}",
+                  file=sys.stderr)
+            return 1
+        return code
+    print("error: unknown plugin subcommand", file=sys.stderr)
+    return 2
+
+
+def run_k8s(args) -> int:
+    """ref pkg/k8s/commands/run.go:58-151 — enumerate, scan, render."""
+    from .k8s import K8sScanner, ManifestClient
+    from .k8s.report import k8s_failed, write_k8s_report
+    if not os.path.exists(args.target):
+        print(f"error: no such path: {args.target}", file=sys.stderr)
+        return 1
+    checks = [c for c in args.security_checks.split(",") if c]
+    scanner = K8sScanner(
+        store=_store(args),
+        backend=args.backend,
+        images_dir=args.images_dir,
+        security_checks=checks)
+    report = scanner.scan(ManifestClient(args.target))
+    from .scan.filter import IgnorePolicyError, load_ignore_policy
+    try:
+        policy = load_ignore_policy(
+            getattr(args, "ignore_policy", ""))
+        for res in report.vulnerabilities + \
+                report.misconfigurations:
+            filter_results(
+                res.results, _severities(args.severity),
+                ignore_unfixed=args.ignore_unfixed,
+                ignored_ids=load_ignore_file(args.ignorefile),
+                policy=policy,
+                include_non_failures=getattr(
+                    args, "include_non_failures", False))
+    except (OSError, IgnorePolicyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        write_k8s_report(report, fmt=args.format, mode=args.report,
+                         output=out)
+    finally:
+        if args.output:
+            out.close()
+    if args.exit_code and k8s_failed(report):
+        return args.exit_code
+    return 0
 
 
 def run_server(args) -> int:
@@ -320,7 +450,7 @@ def _scan_options(args) -> ScanOptions:
 
 
 def _finish(args, report: Report) -> int:
-    from .scan.filter import load_ignore_policy
+    from .scan.filter import IgnorePolicyError, load_ignore_policy
     try:
         policy = load_ignore_policy(
             getattr(args, "ignore_policy", ""))
@@ -331,14 +461,11 @@ def _finish(args, report: Report) -> int:
             policy=policy,
             include_non_failures=getattr(
                 args, "include_non_failures", False))
-    except Exception as e:              # noqa: BLE001 — a broken
-        # user policy (bad import, raise inside ignore()) must fail
-        # cleanly, like the reference's Rego eval errors
-        if getattr(args, "ignore_policy", ""):
-            print(f"error: ignore policy failed: {e!r}",
-                  file=sys.stderr)
-            return 1
-        raise
+    except (OSError, IgnorePolicyError) as e:
+        # a broken user policy fails cleanly, like the reference's
+        # Rego eval errors; unrelated bugs keep their traceback
+        print(f"error: ignore policy failed: {e}", file=sys.stderr)
+        return 1
     report.results = [r for r in results if not r.empty()]
     out = open(args.output, "w") if args.output else sys.stdout
     try:

@@ -94,9 +94,12 @@ def _convert(action, raw):
         return str(raw).strip().lower() in ("1", "true", "yes", "on")
     if isinstance(raw, list):
         raw = ",".join(str(x) for x in raw)
-    if action.type is not None:
-        return action.type(raw)
-    return str(raw)
+    value = action.type(raw) if action.type is not None else str(raw)
+    if action.choices is not None and value not in action.choices:
+        raise ValueError(
+            f"{value!r} (choose from "
+            f"{', '.join(map(str, action.choices))})")
+    return value
 
 
 def _walk_parsers(parser):

@@ -1,0 +1,135 @@
+"""In-process extension modules (reference: pkg/module — WASM via
+wazero).
+
+The reference loads ``~/.trivy/modules/*.wasm`` and registers each as
+an analyzer and/or post-scanner through a handshake of exports
+(module.go:573-680). The TPU-native analog loads
+``~/.trivy-tpu/modules/*.py`` with the same handshake as module-level
+attributes:
+
+    name = "spring4shell"
+    version = 1
+    api_version = 1
+    is_analyzer = True          # implement required()/analyze()
+    is_post_scanner = True      # implement post_scan(results)
+    required_files = [r"\\.java$"]   # regex list, like Required()
+
+Analyzer modules see (path, content) and return a dict of custom
+resource data (surfaced as CustomResources); post-scanner modules
+rewrite the results list (INSERT/UPDATE/DELETE by returning the
+modified list, api/api.go's action set collapsed into
+return-the-new-results).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import types as types_mod
+from typing import Optional
+
+from ..analyzer.analyzer import (AnalysisResult, Analyzer,
+                                 register_analyzer)
+from ..scan.post import register_post_scanner
+from ..types.artifact import CustomResource
+from ..utils import get_logger
+
+log = get_logger("module")
+
+SUPPORTED_API_VERSION = 1
+
+# absolute paths already registered this process — repeated
+# cli.main() calls must not re-register analyzers (the global
+# analyzer registry appends without dedup)
+_LOADED: set = set()
+
+
+def modules_dir() -> str:
+    return os.environ.get(
+        "TRIVY_MODULE_DIR",
+        os.path.join(os.path.expanduser("~"), ".trivy-tpu",
+                     "modules"))
+
+
+class _ModuleAnalyzer(Analyzer):
+    def __init__(self, mod):
+        self.mod = mod
+        self.type = f"module:{mod.name}"
+        self.version = getattr(mod, "version", 1)
+        self._patterns = [re.compile(p) for p in
+                          getattr(mod, "required_files", [])]
+
+    def required(self, path: str, size: Optional[int] = None) -> bool:
+        if hasattr(self.mod, "required"):
+            return bool(self.mod.required(path, size))
+        return any(p.search(path) for p in self._patterns)
+
+    def analyze(self, path: str, content: bytes) -> AnalysisResult:
+        r = AnalysisResult()
+        data = self.mod.analyze(path, content)
+        if data:
+            r.custom_resources.append(CustomResource(
+                type=self.type, file_path=path, data=data))
+        return r
+
+
+class _ModulePostScanner:
+    def __init__(self, mod):
+        self.mod = mod
+        self.name = mod.name
+        self.version = getattr(mod, "version", 1)
+
+    def post_scan(self, results: list) -> list:
+        return self.mod.post_scan(results)
+
+
+class Manager:
+    """Loads and registers modules (ref module.go:80-149)."""
+
+    def __init__(self, directory: str = ""):
+        self.directory = directory or modules_dir()
+        self.modules: list = []
+
+    def load(self) -> list:
+        if not os.path.isdir(self.directory):
+            return []
+        for fname in sorted(os.listdir(self.directory)):
+            if not fname.endswith(".py") or fname.startswith("_"):
+                continue
+            path = os.path.abspath(
+                os.path.join(self.directory, fname))
+            if path in _LOADED:
+                continue
+            try:
+                mod = self._load_one(path)
+                _LOADED.add(path)
+            except Exception as e:      # noqa: BLE001 — a broken
+                # module must not brick the scanner
+                log.warning("failed to load module %s: %r",
+                            path, e)
+                continue
+            self.modules.append(mod)
+        return self.modules
+
+    def _load_one(self, path: str):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        mod = types_mod.ModuleType(
+            "trivy_module_" +
+            os.path.basename(path).removesuffix(".py"))
+        exec(compile(source, path, "exec"), mod.__dict__)
+        name = getattr(mod, "name", "")
+        api = getattr(mod, "api_version", 1)
+        if not name:
+            raise ValueError("module must set `name`")
+        if api > SUPPORTED_API_VERSION:
+            raise ValueError(
+                f"module {name} requires api_version {api} > "
+                f"{SUPPORTED_API_VERSION}")
+        if getattr(mod, "is_analyzer", False):
+            register_analyzer(_ModuleAnalyzer(mod))
+            log.info("registered module analyzer %s", name)
+        if getattr(mod, "is_post_scanner", False):
+            register_post_scanner(_ModulePostScanner(mod))
+            log.info("registered module post-scanner %s", name)
+        return mod

@@ -14,6 +14,11 @@ from typing import Callable, Optional
 from ..types import Severity
 
 
+class IgnorePolicyError(Exception):
+    """A user ignore-policy failed to load or raised while
+    evaluating a finding."""
+
+
 def load_ignore_policy(path: str):
     """--ignore-policy: a Python file defining ``ignore(finding) ->
     bool`` over the finding's JSON dict (the analog of the
@@ -25,12 +30,21 @@ def load_ignore_policy(path: str):
     with open(path, encoding="utf-8") as f:
         source = f.read()
     mod = _types.ModuleType("trivy_ignore_policy")
-    exec(compile(source, path, "exec"), mod.__dict__)
+    try:
+        exec(compile(source, path, "exec"), mod.__dict__)
+    except Exception as e:               # noqa: BLE001
+        raise IgnorePolicyError(f"{path}: {e!r}")
     fn = getattr(mod, "ignore", None)
     if not callable(fn):
-        raise ValueError(
+        raise IgnorePolicyError(
             f"ignore policy {path} must define ignore(finding)")
-    return lambda finding: bool(fn(finding.to_dict()))
+
+    def predicate(finding):
+        try:
+            return bool(fn(finding.to_dict()))
+        except Exception as e:           # noqa: BLE001
+            raise IgnorePolicyError(f"ignore() raised: {e!r}")
+    return predicate
 
 
 def load_ignore_file(path: str = ".trivyignore") -> list:
@@ -63,10 +77,12 @@ def filter_results(results: list, severities: list,
             include_non_failures, policy)
         r.secrets = [s for s in r.secrets
                      if s.severity in sev_names
-                     and s.rule_id not in ignored]
+                     and s.rule_id not in ignored
+                     and not (policy is not None and policy(s))]
         r.licenses = [lic for lic in r.licenses
                       if lic.severity in sev_names
-                      and lic.name not in ignored]
+                      and lic.name not in ignored
+                      and not (policy is not None and policy(lic))]
     return results
 
 
