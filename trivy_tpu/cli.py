@@ -195,7 +195,10 @@ def main(argv=None) -> int:
         if code is not None:
             return code
     parser = build_parser()
-    apply_external_defaults(parser, raw_argv)
+    if not raw_argv or raw_argv[0] != "plugin":
+        # plugin argv (incl. REMAINDER passthrough) is never
+        # inspected for --config or rewritten by env defaults
+        apply_external_defaults(parser, raw_argv)
     args = parser.parse_args(argv)
     timeout_s = 0.0
     if getattr(args, "timeout", ""):
