@@ -199,3 +199,66 @@ class TestCLI:
             "--backend", "cpu", "--exit-code", "5",
             "--cache-dir", str(tmp_path / "c")])
         assert code == 5
+
+
+class TestCompliance:
+    def _run(self, argv):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+
+    def test_nsa_summary(self, manifests, tmp_path):
+        code, out = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--compliance", "nsa",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        assert "National Security Agency" in out
+        # privileged deployment fails control 1.4 (KSV017)
+        assert any("1.4" in line and "FAIL" in line
+                   for line in out.splitlines())
+
+    def test_nsa_json(self, manifests, tmp_path):
+        out_file = tmp_path / "r.json"
+        code, _ = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--compliance", "nsa",
+            "--format", "json", "--output", str(out_file),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        doc = json.loads(out_file.read_text())
+        assert doc["ID"] == "nsa"
+        by_id = {c["ID"]: c for c in doc["Controls"]}
+        assert by_id["1.4"]["Status"] == "FAIL"
+        assert by_id["1.4"]["FailTotal"] >= 1
+        # a control with no implemented check honors defaultStatus
+        assert by_id["1.2"]["Status"] == "FAIL"
+
+    def test_custom_spec_file(self, manifests, tmp_path):
+        spec = tmp_path / "spec.yaml"
+        spec.write_text("""spec:
+  id: custom
+  title: Custom policy set
+  version: "0.1"
+  controls:
+    - id: C-1
+      name: no privileged pods
+      checks:
+        - id: KSV017
+      severity: HIGH
+""")
+        out_file = tmp_path / "r.json"
+        code, _ = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--compliance", str(spec),
+            "--format", "json", "--output", str(out_file),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        doc = json.loads(out_file.read_text())
+        assert doc["ID"] == "custom"
+        assert doc["Controls"][0]["Status"] == "FAIL"

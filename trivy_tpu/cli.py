@@ -115,6 +115,14 @@ def build_parser() -> argparse.ArgumentParser:
     rootfs.add_argument("target")
     scan_flags(rootfs)
 
+    repo = sub.add_parser("repo", help="scan a remote or local git "
+                          "repository")
+    repo.add_argument("target", help="repo URL or local path")
+    repo.add_argument("--branch", default="")
+    repo.add_argument("--tag", default="")
+    repo.add_argument("--commit", default="")
+    scan_flags(repo)
+
     sbom = sub.add_parser("sbom", help="scan an SBOM document "
                           "(CycloneDX/SPDX, vuln checks only)")
     sbom.add_argument("target")
@@ -131,6 +139,9 @@ def build_parser() -> argparse.ArgumentParser:
     k8s.add_argument("--images-dir", default="",
                      help="directory of image tarballs named "
                      "<ref with /:@ as _>.tar")
+    k8s.add_argument("--compliance", default="",
+                     help="compliance spec: built-in name (nsa) or "
+                     "a YAML spec file")
     scan_flags(k8s)
 
     db = sub.add_parser("db", help="advisory DB operations")
@@ -179,8 +190,9 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-_KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "sbom",
-                   "k8s", "db", "server", "plugin", "version")
+_KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
+                   "sbom", "k8s", "db", "server", "plugin",
+                   "version")
 
 
 def main(argv=None) -> int:
@@ -221,13 +233,15 @@ def _dispatch(args) -> int:
         print(f"trivy-tpu {__version__}")
         return 0
     if args.command in ("image", "filesystem", "fs", "rootfs",
-                        "sbom", "k8s"):
+                        "repo", "sbom", "k8s"):
         from .module import Manager as _ModuleManager
         _ModuleManager().load()
     if args.command in ("image",):
         return run_image(args)
     if args.command in ("filesystem", "fs", "rootfs"):
         return run_fs(args)
+    if args.command == "repo":
+        return run_repo(args)
     if args.command == "sbom":
         return run_sbom(args)
     if args.command == "db":
@@ -297,6 +311,12 @@ def run_k8s(args) -> int:
         images_dir=args.images_dir,
         security_checks=checks)
     report = scanner.scan(ManifestClient(args.target))
+    import copy
+    compliance_results = [copy.deepcopy(res) for group in
+                          (report.misconfigurations,
+                           report.vulnerabilities)
+                          for r in group for res in r.results] \
+        if args.compliance else []
     from .scan.filter import IgnorePolicyError, load_ignore_policy
     try:
         policy = load_ignore_policy(
@@ -315,8 +335,23 @@ def run_k8s(args) -> int:
         return 1
     out = open(args.output, "w") if args.output else sys.stdout
     try:
-        write_k8s_report(report, fmt=args.format, mode=args.report,
-                         output=out)
+        if args.compliance:
+            # compliance maps the RAW scan outcome — severity and
+            # non-failure filtering must not blank out controls
+            from .compliance import (build_report, load_spec,
+                                     write_compliance)
+            try:
+                spec = load_spec(args.compliance)
+            except (OSError, ValueError) as e:
+                print(f"error: compliance spec: {e}",
+                      file=sys.stderr)
+                return 1
+            write_compliance(
+                build_report(spec, compliance_results),
+                fmt=args.format, output=out)
+        else:
+            write_k8s_report(report, fmt=args.format,
+                             mode=args.report, output=out)
     finally:
         if args.output:
             out.close()
@@ -534,7 +569,14 @@ def run_image(args) -> int:
               file=sys.stderr)
         return 2
     try:
-        image = load_image(path, name=args.target or path)
+        if args.input:
+            # an explicit archive path must fail as a file error,
+            # never fall through to daemon/registry resolution
+            image = load_image(args.input,
+                               name=args.target or args.input)
+        else:
+            from .artifact.resolve import resolve_image
+            image = resolve_image(path, name=args.target or path)
     except (OSError, ValueError, tarfile_error) as e:
         print(f"error: failed to load image {path!r}: {e}",
               file=sys.stderr)
@@ -601,6 +643,38 @@ def run_sbom(args) -> int:
         metadata=Metadata(os=os_found),
         results=results,
         cyclonedx=ref.cyclonedx,
+    )
+    return _finish(args, report)
+
+
+def run_repo(args) -> int:
+    """Scan a git repository (ref pkg/fanal/artifact/remote)."""
+    from .artifact.remote import GitError, RemoteRepoArtifact
+    cache = _cache(args)
+    artifact = RemoteRepoArtifact(
+        args.target, cache, option=_artifact_option(args),
+        branch=args.branch, tag=args.tag, commit=args.commit)
+    try:
+        try:
+            ref = artifact.inspect()
+        except GitError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        try:
+            results, os_found = _scanner(args, cache).scan(
+                ScanTarget(name=ref.name, artifact_id=ref.id,
+                           blob_ids=ref.blob_ids),
+                _scan_options(args))
+        except _rpc_error() as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    finally:
+        artifact.clean()
+    report = Report(
+        artifact_name=args.target,
+        artifact_type="repository",
+        metadata=Metadata(os=os_found),
+        results=results,
     )
     return _finish(args, report)
 
