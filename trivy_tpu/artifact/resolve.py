@@ -17,11 +17,10 @@ does, adapted to this runtime:
 
 from __future__ import annotations
 
+import atexit
 import http.client
-import json
 import os
 import socket
-import tarfile
 import tempfile
 from typing import Optional
 
@@ -118,17 +117,25 @@ def resolve_image(ref: str, name: Optional[str] = None,
 
     # 2. daemon export
     daemon = daemon or DaemonClient()
+    daemon_err = ""
     if daemon.available_socket():
         try:
             tmp = daemon.export(ref)
         except ResolveError as e:
-            log.debug("daemon resolution failed: %s", e)
+            daemon_err = str(e)
+            log.warning("daemon resolution failed: %s", e)
         else:
-            try:
-                return load_image(tmp, name=name or ref)
-            finally:
-                os.unlink(tmp)
+            # layers read lazily from the exported tar during the
+            # scan — the file must outlive this call
+            atexit.register(
+                lambda p=tmp: os.path.exists(p) and os.unlink(p))
+            return load_image(tmp, name=name or ref)
 
     # 3. registry pull
     registry = registry or RegistryClient()
-    return registry.pull(ref)
+    try:
+        return registry.pull(ref)
+    except ResolveError as e:
+        if daemon_err:
+            raise ResolveError(f"{e} (daemon: {daemon_err})")
+        raise

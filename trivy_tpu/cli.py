@@ -86,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--no-cache", action="store_true")
         sp.add_argument("--timeout", default="5m0s",
                         help="scan timeout (e.g. 5m0s)")
+        sp.add_argument("--profile-dir", default="",
+                        help="write a jax.profiler device trace + "
+                        "host/device phase timings here")
         sp.add_argument("--config", "-c", default="",
                         help="config file (default: trivy.yaml)")
         sp.add_argument("--server", default="",
@@ -220,12 +223,32 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
     try:
-        with scan_deadline(timeout_s):
+        with scan_deadline(timeout_s), \
+                _profiled(getattr(args, "profile_dir", "")):
             return _dispatch(args)
     except ScanTimeout:
         print(f"error: scan timeout of {args.timeout} exceeded "
               "(raise with --timeout)", file=sys.stderr)
         return 1
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _profiled(profile_dir: str):
+    """--profile-dir: capture a jax.profiler trace of the scan (the
+    reference's pprof/trace analog; SURVEY §5 tracing row). The trace
+    opens in TensorBoard/Perfetto; phase-level host/device timings
+    live in BatchScanRunner.last_stats and the bench JSON."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(profile_dir):
+        yield
+    print(f"profile trace written to {profile_dir}",
+          file=sys.stderr)
 
 
 def _dispatch(args) -> int:
@@ -340,6 +363,10 @@ def run_k8s(args) -> int:
             # non-failure filtering must not blank out controls
             from .compliance import (build_report, load_spec,
                                      write_compliance)
+            if args.format not in ("table", "json"):
+                print(f"error: compliance reports support table/"
+                      f"json, not {args.format}", file=sys.stderr)
+                return 2
             try:
                 spec = load_spec(args.compliance)
             except (OSError, ValueError) as e:

@@ -119,6 +119,9 @@ def load_spec(name_or_path: str) -> Spec:
                 doc = yaml_mod.safe_load(f) or {}
         except yaml_mod.YAMLError as e:
             raise ValueError(f"invalid spec yaml: {e}")
+    if not isinstance(doc, dict):
+        raise ValueError("spec yaml must be a mapping with a "
+                         "top-level 'spec' key")
     raw = doc.get("spec") or {}
     controls = []
     for c in raw.get("controls") or []:

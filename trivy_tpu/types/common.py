@@ -103,6 +103,22 @@ def _convert(v: Any) -> Any:
     return v
 
 
+# per-class (attr, json name, keep) specs — dataclasses.fields() and
+# metadata mappingproxy lookups dominate serialization on the SBOM
+# fleet path otherwise
+_FIELD_SPECS: dict = {}
+
+
+def _field_spec(cls) -> list:
+    spec = _FIELD_SPECS.get(cls)
+    if spec is None:
+        spec = [(f.name, f.metadata.get("json", f.name),
+                 f.metadata.get("keep", False))
+                for f in dataclasses.fields(cls)]
+        _FIELD_SPECS[cls] = spec
+    return spec
+
+
 def asdict_omitempty(obj: Any) -> dict:
     """Serialize a dataclass to a JSON-ready dict.
 
@@ -111,12 +127,10 @@ def asdict_omitempty(obj: Any) -> dict:
       - ``keep``: always emit, even when empty (Go fields without omitempty)
     """
     out: dict = {}
-    for f in dataclasses.fields(obj):
-        v = getattr(obj, f.name)
-        keep = f.metadata.get("keep", False)
+    for attr, name, keep in _field_spec(type(obj)):
+        v = getattr(obj, attr)
         if not keep and omitempty(v):
             continue
-        name = f.metadata.get("json", f.name)
         out[name] = _convert(v)
     return out
 
