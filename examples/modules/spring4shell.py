@@ -1,0 +1,36 @@
+"""Example extension module (the analog of the reference's
+examples/module/spring4shell WASM module).
+
+Drop into ~/.trivy-tpu/modules/ to activate: flags Spring4Shell
+(CVE-2022-22965) exposure by spotting vulnerable spring-beans usage
+in scanned jars and rewriting the severity of matching findings.
+"""
+
+name = "spring4shell"
+version = 1
+api_version = 1
+is_analyzer = True
+is_post_scanner = True
+required_files = [r"\.jar$"]
+
+VULN_ID = "CVE-2022-22965"
+
+
+def analyze(path, content):
+    # a real module would inspect the jar's JDK target; the example
+    # records which jars bundle spring-beans
+    if b"spring-beans" in content or b"CachedIntrospectionResults" \
+            in content:
+        return {"spring_beans": True, "path": path}
+    return None
+
+
+def post_scan(results):
+    """Raise Spring4Shell to CRITICAL when the analyzer saw evidence
+    of an exploitable deployment (the reference's example DELETEs or
+    UPDATEs findings the same way)."""
+    for r in results:
+        for v in r.vulnerabilities:
+            if v.vulnerability_id == VULN_ID:
+                v.vulnerability.severity = "CRITICAL"
+    return results

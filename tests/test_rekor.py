@@ -1,0 +1,109 @@
+"""Rekor client tests against a local fake server (mirrors
+pkg/rekor/client_test.go's fake-API strategy)."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.rekor import Client, EntryID, RekorError
+
+
+@pytest.fixture()
+def fake_rekor():
+    uuid = "a" * 64
+    statement = json.dumps({"predicateType":
+                            "https://cyclonedx.org/bom"}).encode()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if self.path == "/api/v1/index/retrieve":
+                out = [uuid] if body.get("hash", "").startswith(
+                    "sha256:feed") else []
+            else:
+                out = [{u: {"attestation": {
+                    "data": base64.b64encode(statement).decode()}}}
+                    for u in body.get("entryUUIDs", [])]
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", statement
+    httpd.shutdown()
+
+
+class TestEntryID:
+    def test_parse_forms(self):
+        long = EntryID.parse("1" * 16 + "a" * 64)
+        assert (long.tree_id, long.uuid) == ("1" * 16, "a" * 64)
+        short = EntryID.parse("b" * 64)
+        assert (short.tree_id, short.uuid) == ("", "b" * 64)
+        with pytest.raises(RekorError):
+            EntryID.parse("zzz")
+
+
+class TestClient:
+    def test_search_and_get_entries(self, fake_rekor):
+        url, statement = fake_rekor
+        c = Client(url)
+        ids = c.search("sha256:feedface")
+        assert len(ids) == 1
+        entries = c.get_entries(ids)
+        assert entries[0].statement == statement
+        assert c.search("sha256:other") == []
+
+    def test_entry_limit(self, fake_rekor):
+        url, _ = fake_rekor
+        with pytest.raises(RekorError, match="limit"):
+            Client(url).get_entries(
+                [EntryID(uuid="c" * 64)] * 11)
+
+    def test_unreachable_is_clean_error(self):
+        with pytest.raises(RekorError, match="egress"):
+            Client("http://127.0.0.1:1", timeout_s=0.5).search(
+                "sha256:x")
+
+
+class TestExampleModule:
+    @pytest.fixture()
+    def _clean_registries(self):
+        yield
+        from trivy_tpu.analyzer.analyzer import _REGISTRY
+        from trivy_tpu.scan.post import deregister_post_scanner
+        deregister_post_scanner("spring4shell")
+        _REGISTRY[:] = [a for a in _REGISTRY
+                        if a.type != "module:spring4shell"]
+
+    def test_spring4shell_module_loads(self, tmp_path,
+                                       _clean_registries):
+        import shutil
+
+        from trivy_tpu.module import Manager
+        mod_dir = tmp_path / "modules"
+        mod_dir.mkdir()
+        shutil.copy("examples/modules/spring4shell.py",
+                    mod_dir / "spring4shell.py")
+        mods = Manager(str(mod_dir)).load()
+        assert [m.name for m in mods] == ["spring4shell"]
+        assert mods[0].analyze(
+            "x.jar", b"...spring-beans...") == {
+                "spring_beans": True, "path": "x.jar"}
+
+    def test_discover_sbom(self, fake_rekor):
+        """The attestation-discovery integration point decodes a
+        CycloneDX predicate from the log."""
+        url, _ = fake_rekor
+        from trivy_tpu.rekor import Client, discover_sbom
+        out = discover_sbom(Client(url), "sha256:feedface")
+        assert out is not None

@@ -126,10 +126,14 @@ def resolve_image(ref: str, name: Optional[str] = None,
             log.warning("daemon resolution failed: %s", e)
         else:
             # layers read lazily from the exported tar during the
-            # scan — the file must outlive this call
-            atexit.register(
-                lambda p=tmp: os.path.exists(p) and os.unlink(p))
-            return load_image(tmp, name=name or ref)
+            # scan — the file must outlive this call. The scan
+            # driver calls src.cleanup() when done; atexit is the
+            # backstop for library users who forget.
+            src = load_image(tmp, name=name or ref)
+            src.cleanup = lambda: (os.path.exists(tmp) and
+                                   os.unlink(tmp))
+            atexit.register(src.cleanup)
+            return src
 
     # 3. registry pull
     registry = registry or RegistryClient()

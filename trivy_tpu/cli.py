@@ -245,10 +245,14 @@ def _profiled(profile_dir: str):
         yield
         return
     import jax
-    with jax.profiler.trace(profile_dir):
-        yield
-    print(f"profile trace written to {profile_dir}",
-          file=sys.stderr)
+    try:
+        with jax.profiler.trace(profile_dir):
+            yield
+    finally:
+        # the trace flushes even when the scan errors or times out —
+        # exactly when it is most wanted
+        print(f"profile trace written to {profile_dir}",
+              file=sys.stderr)
 
 
 def _dispatch(args) -> int:
@@ -327,6 +331,10 @@ def run_k8s(args) -> int:
     if not os.path.exists(args.target):
         print(f"error: no such path: {args.target}", file=sys.stderr)
         return 1
+    if args.compliance and args.format not in ("table", "json"):
+        print(f"error: compliance reports support table/json, not "
+              f"{args.format}", file=sys.stderr)
+        return 2
     checks = [c for c in args.security_checks.split(",") if c]
     scanner = K8sScanner(
         store=_store(args),
@@ -363,10 +371,6 @@ def run_k8s(args) -> int:
             # non-failure filtering must not blank out controls
             from .compliance import (build_report, load_spec,
                                      write_compliance)
-            if args.format not in ("table", "json"):
-                print(f"error: compliance reports support table/"
-                      f"json, not {args.format}", file=sys.stderr)
-                return 2
             try:
                 spec = load_spec(args.compliance)
             except (OSError, ValueError) as e:
@@ -621,6 +625,8 @@ def run_image(args) -> int:
     except _rpc_error() as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    finally:
+        getattr(image, "cleanup", lambda: None)()
 
     report = Report(
         artifact_name=ref.name,
