@@ -16,19 +16,26 @@ required_files = [r"\.jar$"]
 VULN_ID = "CVE-2022-22965"
 
 
+# jars where the analyzer saw spring-beans evidence this process
+_EVIDENCE = set()
+
+
 def analyze(path, content):
     # a real module would inspect the jar's JDK target; the example
     # records which jars bundle spring-beans
     if b"spring-beans" in content or b"CachedIntrospectionResults" \
             in content:
+        _EVIDENCE.add(path)
         return {"spring_beans": True, "path": path}
     return None
 
 
 def post_scan(results):
-    """Raise Spring4Shell to CRITICAL when the analyzer saw evidence
-    of an exploitable deployment (the reference's example DELETEs or
-    UPDATEs findings the same way)."""
+    """Raise Spring4Shell to CRITICAL only when the analyzer saw
+    evidence of an exploitable deployment (the reference's example
+    DELETEs or UPDATEs findings the same way)."""
+    if not _EVIDENCE:
+        return results
     for r in results:
         for v in r.vulnerabilities:
             if v.vulnerability_id == VULN_ID:

@@ -1,0 +1,240 @@
+"""Redis cache backend (against a fake RESP server) + AWS
+account-state scanning tests."""
+
+import contextlib
+import io
+import json
+import socket
+import threading
+
+import pytest
+
+from trivy_tpu.artifact.redis_cache import RedisCache, RespClient
+from trivy_tpu.types.artifact import (OS, ArtifactInfo, BlobInfo,
+                                      Package, PackageInfo)
+
+
+@pytest.fixture()
+def fake_redis():
+    """In-memory RESP2 server speaking the commands the cache uses."""
+    store = {}
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def read_command(f):
+        line = f.readline()
+        if not line:
+            return None
+        n = int(line[1:].strip())
+        args = []
+        for _ in range(n):
+            length = int(f.readline()[1:].strip())
+            args.append(f.read(length))
+            f.read(2)
+        return [a.decode() for a in args]
+
+    def serve(conn):
+        f = conn.makefile("rb")
+        while not stop.is_set():
+            try:
+                cmd = read_command(f)
+            except (ValueError, OSError):
+                break
+            if cmd is None:
+                break
+            op = cmd[0].upper()
+            if op == "SET":
+                store[cmd[1]] = cmd[2].encode()
+                reply = b"+OK\r\n"
+            elif op == "GET":
+                v = store.get(cmd[1])
+                reply = b"$-1\r\n" if v is None else \
+                    b"$%d\r\n%s\r\n" % (len(v), v)
+            elif op == "EXISTS":
+                reply = b":%d\r\n" % (1 if cmd[1] in store else 0)
+            elif op == "DEL":
+                reply = b":%d\r\n" % (
+                    1 if store.pop(cmd[1], None) is not None else 0)
+            elif op == "KEYS":
+                prefix = cmd[1].rstrip("*")
+                keys = [k.encode() for k in store
+                        if k.startswith(prefix)]
+                reply = b"*%d\r\n" % len(keys) + b"".join(
+                    b"$%d\r\n%s\r\n" % (len(k), k) for k in keys)
+            else:
+                reply = b"-ERR unknown\r\n"
+            try:
+                conn.sendall(reply)
+            except OSError:
+                break
+        conn.close()
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                break
+            threading.Thread(target=serve, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    yield f"redis://127.0.0.1:{srv.getsockname()[1]}", store
+    stop.set()
+    srv.close()
+
+
+class TestRedisCache:
+    def test_blob_roundtrip_and_missing(self, fake_redis):
+        url, store = fake_redis
+        cache = RedisCache(url)
+        blob = BlobInfo(
+            os=OS(family="alpine", name="3.16.0"),
+            package_infos=[PackageInfo(packages=[
+                Package(name="musl", version="1.2.2")])])
+        missing_artifact, missing = cache.missing_blobs(
+            "sha256:a", ["sha256:b1"])
+        assert missing_artifact and missing == ["sha256:b1"]
+
+        cache.put_blob("sha256:b1", blob)
+        cache.put_artifact("sha256:a", ArtifactInfo(
+            architecture="amd64"))
+        # keys use the reference's fanal::bucket::id layout
+        assert "fanal::blob::sha256:b1" in store
+        assert "fanal::artifact::sha256:a" in store
+
+        missing_artifact, missing = cache.missing_blobs(
+            "sha256:a", ["sha256:b1"])
+        assert not missing_artifact and missing == []
+
+        out = cache.get_blob("sha256:b1")
+        assert out.os.family == "alpine"
+        assert out.package_infos[0].packages[0].name == "musl"
+        assert cache.get_artifact("sha256:a").architecture == "amd64"
+
+        cache.delete_blobs(["sha256:b1"])
+        assert cache.get_blob("sha256:b1") is None
+
+    def test_scan_through_redis_cache(self, fake_redis, tmp_path):
+        """Full CLI image scan with --cache-backend redis://."""
+        from tests.test_e2e_image import (FIXTURE_DB, make_image_tar,
+                                          run_cli)
+        url, store = fake_redis
+        img = make_image_tar(tmp_path, [{
+            "etc/alpine-release": b"3.9.4\n",
+            "lib/apk/db/installed":
+                b"P:musl\nV:1.1.20-r4\no:musl\nL:MIT\n\n"}])
+        dbf = tmp_path / "db.yaml"
+        dbf.write_text(FIXTURE_DB)
+        out = tmp_path / "r.json"
+        code, _ = run_cli([
+            "image", "--input", img, "--format", "json",
+            "--db-fixtures", str(dbf), "--backend", "cpu",
+            "--cache-backend", url, "--output", str(out),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        ids = [v["VulnerabilityID"]
+               for r in json.loads(out.read_text())["Results"]
+               for v in r.get("Vulnerabilities", [])]
+        assert "CVE-2019-14697" in ids
+        assert any(k.startswith("fanal::blob::") for k in store)
+
+    def test_connect_error(self):
+        from trivy_tpu.artifact.redis_cache import RedisError
+        with pytest.raises(RedisError):
+            RespClient("127.0.0.1", 1, timeout_s=0.5)
+
+
+ACCOUNT_STATE = {
+    "state": {"aws": {
+        "s3": {"buckets": [
+            {"name": "public-bucket",
+             "publicAccessBlock": {"blockPublicAcls": False},
+             "encryption": {"enabled": True}},
+            {"name": "good-bucket",
+             "publicAccessBlock": {
+                 "blockPublicAcls": True,
+                 "blockPublicPolicy": True,
+                 "ignorePublicAcls": True,
+                 "restrictPublicBuckets": True},
+             "encryption": {"enabled": True}},
+        ]},
+        "ec2": {"securityGroups": [
+            {"name": "web", "ingressRules": [
+                {"cidrs": ["0.0.0.0/0"], "fromPort": 22,
+                 "toPort": 22}]},
+        ]},
+        "iam": {"rootUser": {"accessKeys": ["AKIA..."]},
+                "users": [{"name": "alice", "consoleAccess": True,
+                           "mfaActive": True}]},
+        "cloudtrail": {"trails": [{"isLogging": True}]},
+    }},
+}
+
+
+class TestAWS:
+    def _run(self, argv):
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()
+
+    def test_account_scan(self, tmp_path):
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(ACCOUNT_STATE))
+        out = tmp_path / "r.json"
+        code, _ = self._run([
+            "aws", "--account-state", str(state),
+            "--format", "json", "--output", str(out),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        report = json.loads(out.read_text())
+        assert report["ArtifactType"] == "aws_account"
+        by_target = {r["Target"]: r for r in report["Results"]}
+        s3_ids = {m["ID"] for m in
+                  by_target["aws/s3"]["Misconfigurations"]}
+        assert "AWS-0086" in s3_ids          # public bucket
+        ec2 = by_target["aws/ec2"]["Misconfigurations"]
+        assert {m["ID"] for m in ec2} == {"AWS-0105", "AWS-0107"}
+        iam_ids = {m["ID"] for m in
+                   by_target["aws/iam"]["Misconfigurations"]}
+        assert "AWS-0141" in iam_ids          # root access keys
+        assert "AWS-0123" not in iam_ids      # alice has MFA
+        # cloudtrail is logging → all-pass service filtered out of
+        # failures but summary remains
+        assert by_target["aws/cloudtrail"]["MisconfSummary"][
+            "Successes"] == 1
+
+    def test_service_filter(self, tmp_path):
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(ACCOUNT_STATE))
+        out = tmp_path / "r.json"
+        code, _ = self._run([
+            "aws", "--account-state", str(state), "--service", "s3",
+            "--format", "json", "--output", str(out),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        targets = {r["Target"] for r in
+                   json.loads(out.read_text())["Results"]}
+        assert targets == {"aws/s3"}
+
+    def test_exit_code(self, tmp_path):
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(ACCOUNT_STATE))
+        code, _ = self._run([
+            "aws", "--account-state", str(state),
+            "--exit-code", "6",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 6
+
+    def test_bad_state(self, tmp_path):
+        state = tmp_path / "state.json"
+        state.write_text("[]")
+        code, _ = self._run([
+            "aws", "--account-state", str(state),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 1

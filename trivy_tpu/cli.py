@@ -84,6 +84,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(path prefix from 'trivy-tpu db build')")
         sp.add_argument("--secret-config", default="trivy-secret.yaml")
         sp.add_argument("--no-cache", action="store_true")
+        sp.add_argument("--cache-backend", default="fs",
+                        help="layer cache backend: fs | "
+                        "redis://host:port")
         sp.add_argument("--timeout", default="5m0s",
                         help="scan timeout (e.g. 5m0s)")
         sp.add_argument("--profile-dir", default="",
@@ -147,6 +150,17 @@ def build_parser() -> argparse.ArgumentParser:
                      "a YAML spec file")
     scan_flags(k8s)
 
+    aws = sub.add_parser(
+        "aws", help="scan AWS account state (exported account-state "
+        "JSON; live API walk is a seam)")
+    aws.add_argument("--account-state", required=True,
+                     help="exported account state JSON (the "
+                     "account-state cache shape)")
+    aws.add_argument("--service", default="",
+                     help="comma-separated service filter "
+                     "(s3,ec2,iam,cloudtrail)")
+    scan_flags(aws)
+
     db = sub.add_parser("db", help="advisory DB operations")
     dbsub = db.add_subparsers(dest="db_command")
     build = dbsub.add_parser(
@@ -194,7 +208,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
-                   "sbom", "k8s", "db", "server", "plugin",
+                   "sbom", "k8s", "aws", "db", "server", "plugin",
                    "version")
 
 
@@ -222,10 +236,16 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    from .artifact.redis_cache import RedisError
     try:
         with scan_deadline(timeout_s), \
                 _profiled(getattr(args, "profile_dir", "")):
             return _dispatch(args)
+    except (RedisError, ValueError) as e:
+        # cache-backend connect/IO failures and bad backend values
+        # fail cleanly, never with a traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     except ScanTimeout:
         print(f"error: scan timeout of {args.timeout} exceeded "
               "(raise with --timeout)", file=sys.stderr)
@@ -279,7 +299,36 @@ def _dispatch(args) -> int:
         return run_k8s(args)
     if args.command == "plugin":
         return run_plugin(args)
+    if args.command == "aws":
+        return run_aws(args)
     return 2
+
+
+def run_aws(args) -> int:
+    """ref pkg/cloud/aws/commands/run.go over cached account state."""
+    from .cloud import load_account_state, scan_account
+    try:
+        state = load_account_state(args.account_state)
+    except (OSError, ValueError) as e:
+        print(f"error: account state: {e}", file=sys.stderr)
+        return 1
+    from .cloud import KNOWN_SERVICES
+    services = [s.strip().lower()
+                for s in args.service.split(",") if s.strip()]
+    unknown = [s for s in services if s not in KNOWN_SERVICES]
+    if unknown:
+        print(f"error: unknown service(s) {', '.join(unknown)}; "
+              f"choose from {', '.join(KNOWN_SERVICES)}",
+              file=sys.stderr)
+        return 2
+    results = scan_account(state, services or None)
+    report = Report(
+        artifact_name=args.account_state,
+        artifact_type="aws_account",
+        metadata=Metadata(),
+        results=results,
+    )
+    return _finish(args, report)
 
 
 def run_plugin(args) -> int:
@@ -571,6 +620,14 @@ def _cache(args):
         return RemoteCache(args.server, token=args.auth_token,
                            token_header=args.token_header,
                            custom_headers=_custom_headers(args))
+    backend = getattr(args, "cache_backend", "fs")
+    if backend.startswith("redis://"):
+        from .artifact.redis_cache import RedisCache
+        return RedisCache(backend)
+    if backend != "fs":
+        raise ValueError(
+            f"unsupported --cache-backend {backend!r} "
+            "(use 'fs' or redis://host:port)")
     from .artifact.cache import MemoryCache
     if args.no_cache:
         return MemoryCache()

@@ -1,0 +1,223 @@
+"""Cloud/AWS scanning (reference: pkg/cloud — `trivy aws` walks an
+AWS account through defsec's cloud adapters with an account-state
+cache).
+
+The live AWS API walk is a seam (zero egress here): ``trivy-tpu aws
+--account-state state.json`` evaluates the built-in checks against an
+exported account state — the same JSON shape the reference persists
+in its account-state cache (pkg/cloud/aws/cache CacheData.state:
+``{"aws": {service: resources...}}``) — and a live enumerator would
+feed the identical evaluator. Results render per service like every
+other config class.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..misconf.policies import Cause, Policy
+from ..utils import get_logger
+
+log = get_logger("cloud")
+
+
+def _s3_public_access(state: dict) -> list:
+    causes = []
+    for b in (state.get("s3") or {}).get("buckets") or []:
+        pab = b.get("publicAccessBlock") or {}
+        if not all(pab.get(k) for k in
+                   ("blockPublicAcls", "blockPublicPolicy",
+                    "ignorePublicAcls", "restrictPublicBuckets")):
+            causes.append(Cause(
+                message=f"Bucket {b.get('name', '?')!r} does not "
+                "block public access",
+                resource=b.get("name", "")))
+    return causes
+
+
+def _s3_encryption(state: dict) -> list:
+    causes = []
+    for b in (state.get("s3") or {}).get("buckets") or []:
+        if not (b.get("encryption") or {}).get("enabled"):
+            causes.append(Cause(
+                message=f"Bucket {b.get('name', '?')!r} does not "
+                "have encryption enabled",
+                resource=b.get("name", "")))
+    return causes
+
+
+def _ec2_open_ssh(state: dict) -> list:
+    causes = []
+    for sg in (state.get("ec2") or {}).get("securityGroups") or []:
+        for rule in sg.get("ingressRules") or []:
+            cidrs = rule.get("cidrs") or []
+            from_port = rule.get("fromPort", 0)
+            to_port = rule.get("toPort", from_port)
+            if any(c in ("0.0.0.0/0", "::/0") for c in cidrs) and \
+                    from_port <= 22 <= to_port:
+                causes.append(Cause(
+                    message=f"Security group "
+                    f"{sg.get('name', '?')!r} allows SSH from the "
+                    "public internet",
+                    resource=sg.get("name", "")))
+    return causes
+
+
+def _ec2_open_ingress(state: dict) -> list:
+    causes = []
+    for sg in (state.get("ec2") or {}).get("securityGroups") or []:
+        for rule in sg.get("ingressRules") or []:
+            if any(c in ("0.0.0.0/0", "::/0")
+                   for c in rule.get("cidrs") or []):
+                causes.append(Cause(
+                    message=f"Security group "
+                    f"{sg.get('name', '?')!r} has an ingress rule "
+                    "open to the world",
+                    resource=sg.get("name", "")))
+                break
+    return causes
+
+
+def _iam_root_access_keys(state: dict) -> list:
+    root = (state.get("iam") or {}).get("rootUser") or {}
+    if root.get("accessKeys"):
+        return [Cause(message="The root account has active access "
+                      "keys", resource="root")]
+    return []
+
+
+def _iam_mfa(state: dict) -> list:
+    causes = []
+    for u in (state.get("iam") or {}).get("users") or []:
+        if u.get("consoleAccess") and not u.get("mfaActive"):
+            causes.append(Cause(
+                message=f"User {u.get('name', '?')!r} has console "
+                "access without MFA",
+                resource=u.get("name", "")))
+    return causes
+
+
+def _cloudtrail_enabled(state: dict) -> list:
+    trails = (state.get("cloudtrail") or {}).get("trails")
+    if trails is None:
+        return []           # service not exported
+    if not any(t.get("isLogging") for t in trails):
+        return [Cause(message="No CloudTrail trail is logging")]
+    return []
+
+
+def _policy(id_, service, title, severity, check,
+            resolution) -> Policy:
+    return Policy(
+        id=id_, avd_id=f"AVD-{id_}",
+        title=title, description=title, severity=severity,
+        recommended_actions=resolution,
+        references=[f"https://avd.aquasec.com/misconfig/"
+                    f"{id_.lower().replace('-', '')}"],
+        provider="AWS", service=service, check=check)
+
+
+AWS_POLICIES = [
+    _policy("AWS-0086", "s3", "S3 bucket does not block public "
+            "access", "HIGH", _s3_public_access,
+            "Enable the bucket's public access block"),
+    _policy("AWS-0088", "s3", "S3 bucket is unencrypted", "HIGH",
+            _s3_encryption, "Enable bucket encryption"),
+    _policy("AWS-0107", "ec2", "Security group allows public "
+            "ingress to SSH", "CRITICAL", _ec2_open_ssh,
+            "Restrict port 22 to trusted networks"),
+    _policy("AWS-0105", "ec2", "Security group rule open to "
+            "0.0.0.0/0", "MEDIUM", _ec2_open_ingress,
+            "Scope ingress rules to known CIDRs"),
+    _policy("AWS-0141", "iam", "Root account has access keys",
+            "CRITICAL", _iam_root_access_keys,
+            "Delete the root user's access keys"),
+    _policy("AWS-0123", "iam", "Console user without MFA", "HIGH",
+            _iam_mfa, "Require MFA for console users"),
+    _policy("AWS-0014", "cloudtrail", "CloudTrail logging disabled",
+            "MEDIUM", _cloudtrail_enabled,
+            "Enable at least one logging trail"),
+]
+
+
+def load_account_state(path: str) -> dict:
+    """Exported account state: {"aws": {service: ...}} (the
+    reference's CacheData.state shape) or the bare service map."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("account state must be a JSON object")
+    state = doc.get("state", doc)
+    if not isinstance(state, dict):
+        raise ValueError("'state' must be a JSON object")
+    aws = state.get("aws", state)
+    if not isinstance(aws, dict):
+        raise ValueError("'state.aws' must be a JSON object")
+    return aws
+
+
+KNOWN_SERVICES = sorted({p.service for p in AWS_POLICIES})
+
+
+def scan_account(state: dict, services=None) -> list:
+    """→ [Result] per service (ref aws/scanner + report: ARN-scoped
+    resources grouped by service)."""
+    from ..scan.local import _to_detected_misconf
+    from ..types import Result
+    from ..types.common import Layer
+    from ..types.report import (CauseMetadata, MisconfResult,
+                                ResultClass)
+
+    by_service: dict = {}
+    for policy in AWS_POLICIES:
+        if services and policy.service not in services:
+            continue
+        if policy.service not in state:
+            # never report PASS for a service that was not exported —
+            # absence of data is not an audit
+            continue
+        causes = policy.check(state)
+        results = by_service.setdefault(policy.service, [])
+        if causes:
+            for cause in causes:
+                results.append(_to_detected_misconf(
+                    MisconfResult(
+                        namespace=f"builtin.aws.{policy.service}",
+                        query="data.builtin.aws",
+                        message=cause.message,
+                        id=policy.id, avd_id=policy.avd_id,
+                        type="AWS Security Check",
+                        title=policy.title,
+                        description=policy.description,
+                        severity=policy.severity,
+                        recommended_actions=
+                        policy.recommended_actions,
+                        references=list(policy.references),
+                        cause_metadata=CauseMetadata(
+                            provider="AWS",
+                            service=policy.service)),
+                    "CRITICAL", "FAIL", Layer()))
+        else:
+            results.append(_to_detected_misconf(
+                MisconfResult(
+                    namespace=f"builtin.aws.{policy.service}",
+                    query="data.builtin.aws",
+                    message="No issues found",
+                    id=policy.id, avd_id=policy.avd_id,
+                    type="AWS Security Check",
+                    title=policy.title,
+                    severity=policy.severity,
+                    cause_metadata=CauseMetadata(
+                        provider="AWS", service=policy.service)),
+                "UNKNOWN", "PASS", Layer()))
+
+    out = []
+    for service in sorted(by_service):
+        out.append(Result(
+            target=f"aws/{service}",
+            class_=ResultClass.CONFIG,
+            type=f"aws-{service}",
+            misconfigurations=by_service[service]))
+    return out
