@@ -215,3 +215,15 @@ class TestIgnorePolicy:
                          str(policy), "--no-cache",
                          "--cache-dir", str(tmp_path / "c")])
         assert code == 1
+
+
+class TestAmazonLinux2022:
+    def test_usr_lib_system_release(self):
+        """AL2022 moved the release file to usr/lib
+        (ref os/amazonlinux requiredFiles)."""
+        from trivy_tpu.analyzer.os_release import RedHatBaseAnalyzer
+        a = RedHatBaseAnalyzer()
+        assert a.required("usr/lib/system-release")
+        r = a.analyze("usr/lib/system-release",
+                      b"Amazon Linux release 2022 (Amazon Linux)\n")
+        assert (r.os.family, r.os.name) == ("amazon", "2022")

@@ -116,6 +116,9 @@ _REDHAT_FILES = {
     "etc/fedora-release": "fedora",
     "etc/redhat-release": None,       # family parsed from content
     "etc/system-release": None,
+    # Amazon Linux 2022 moved the release file
+    # (ref os/amazonlinux requiredFiles)
+    "usr/lib/system-release": None,
     "usr/lib/fedora-release": "fedora",
 }
 
