@@ -238,11 +238,17 @@ def load_trivy_db(path: str, store=None):
     import json
 
     from ..utils import get_logger
+    from .compiled import gc_paused
     from .store import AdvisoryStore
 
     log = get_logger("db.boltdb")
     if store is None:
         store = AdvisoryStore()
+    with gc_paused():      # same object volume as compile
+        return _load(path, store, log, json)
+
+
+def _load(path, store, log, json):
     n_adv = n_detail = n_skipped = 0
     with BoltDB(path) as db:
         for bname, bucket in db.buckets():
@@ -250,11 +256,13 @@ def load_trivy_db(path: str, store=None):
             if name == "vulnerability":
                 for key, val in bucket.items():
                     try:
+                        # bytes→str first: json.loads(bytes) pays a
+                        # detect_encoding pass per value
                         store.put_vulnerability(
                             key.decode("utf-8", "replace"),
-                            json.loads(val))
+                            json.loads(val.decode("utf-8")))
                         n_detail += 1
-                    except ValueError:
+                    except ValueError:   # UnicodeDecodeError included
                         n_skipped += 1
                         continue
                 continue
@@ -267,9 +275,9 @@ def load_trivy_db(path: str, store=None):
                         store.put_advisory(
                             name, pname,
                             vuln_id.decode("utf-8", "replace"),
-                            json.loads(val))
+                            json.loads(val.decode("utf-8")))
                         n_adv += 1
-                    except ValueError:
+                    except ValueError:   # UnicodeDecodeError included
                         n_skipped += 1
                         continue
     if n_skipped:

@@ -30,6 +30,8 @@ a single constraint.
 
 from __future__ import annotations
 
+import contextlib
+
 import pickle
 import threading
 from bisect import bisect_left
@@ -44,6 +46,21 @@ from ..vercmp import get_comparer
 from .store import Advisory, AdvisoryStore
 
 log = get_logger("db.compiled")
+
+
+@contextlib.contextmanager
+def gc_paused():
+    """Pause the cyclic collector across bulk object construction,
+    restoring the caller's setting (used by compile and the boltdb
+    ingest)."""
+    import gc
+    was_on = gc.isenabled()
+    gc.disable()
+    try:
+        yield
+    finally:
+        if was_on:
+            gc.enable()
 
 def _eco_grammar() -> dict:
     """ecosystem prefix (before ::) → version grammar, derived from
@@ -117,6 +134,14 @@ class CompiledDB:
 
     @classmethod
     def compile(cls, store: AdvisoryStore) -> "CompiledDB":
+        # millions of long-lived row/interval objects make the cyclic
+        # collector quadratic-ish (2.3x at 1M advisories); nothing
+        # cyclic is created here
+        with gc_paused():
+            return cls._compile(store)
+
+    @classmethod
+    def _compile(cls, store: AdvisoryStore) -> "CompiledDB":
         self = cls()
         self.vulnerabilities = dict(store.vulnerabilities)
         self.data_sources = dict(store.data_sources)
