@@ -273,3 +273,52 @@ class TestIsVulnerable:
         # parse errors ⇒ not vulnerable
         assert is_vulnerable(c, "not-a-version", ["<1.0"], [], [])\
             is False
+
+
+class TestAdvisoryRangeShapes:
+    """GHSA feeds write AND-ranges with commas; go-npm-version's
+    constraint regex skips them (regression: comma ranges fell to
+    host fallback and then evaluated as not-vulnerable)."""
+
+    def test_npm_comma_and_range(self):
+        from trivy_tpu.vercmp import get_comparer
+        from trivy_tpu.vercmp.base import is_vulnerable
+        c = get_comparer("npm")
+        assert is_vulnerable(c, "1.5.0", [">=1.0.0, <1.9.0"],
+                             [">=1.9.0"], [])
+        assert not is_vulnerable(c, "0.9.0", [">=1.0.0, <1.9.0"],
+                                 [">=1.9.0"], [])
+        assert not is_vulnerable(c, "1.9.0", [">=1.0.0, <1.9.0"],
+                                 [">=1.9.0"], [])
+        # intervals compile too (device path parity)
+        assert c.constraint_intervals(">=1.0.0, <1.9.0")
+
+    def test_gem_dash_prerelease(self):
+        from trivy_tpu.vercmp import get_comparer
+        g = get_comparer("rubygems")
+        # Gem::Version: "-" starts a (possibly dotted) prerelease
+        assert g.compare("3.4.4-beta.1", "3.4.4") < 0
+        assert g.compare("3.4.4-beta.1", "3.4.4.pre.beta.1") == 0
+
+    def test_npm_comma_compiles_resident(self):
+        """Comma ranges must ride the device tables, not fall back."""
+        from trivy_tpu.db import AdvisoryStore, CompiledDB
+        store = AdvisoryStore()
+        store.put_advisory("npm::Node.js", "lodash", "CVE-1",
+                           {"VulnerableVersions": [">=1.0.0, <1.9.0"],
+                            "PatchedVersions": [">=1.9.0"]})
+        cdb = CompiledDB.compile(store)
+        assert cdb.stats["host_fallback_rows"] == 0
+
+    def test_npm_comma_joined_hyphen_range(self):
+        """A hyphen range inside a comma clause must not silently
+        evaluate as not-vulnerable (review follow-up)."""
+        from trivy_tpu.vercmp import get_comparer
+        from trivy_tpu.vercmp.base import is_vulnerable
+        c = get_comparer("npm")
+        assert is_vulnerable(c, "1.3.0",
+                             ["1.2.3 - 2.0.0, <1.5.0"], [], [])
+        assert not is_vulnerable(c, "1.6.0",
+                                 ["1.2.3 - 2.0.0, <1.5.0"], [], [])
+        assert not is_vulnerable(c, "1.0.0",
+                                 ["1.2.3 - 2.0.0, <1.5.0"], [], [])

@@ -59,8 +59,11 @@ class NpmComparer(Comparer):
     @staticmethod
     def _tokens(text: str) -> list:
         """node-semver tolerates whitespace between an operator and
-        its version ("< 3.4.0"); rejoin such split tokens."""
-        raw = text.split()
+        its version ("< 3.4.0"); rejoin such split tokens. Commas are
+        AND separators in the advisory feeds' range syntax
+        (">=1.0.0, <1.4.2" — go-npm-version's constraint regex skips
+        them the same way)."""
+        raw = text.replace(",", " ").split()
         out: list = []
         i = 0
         while i < len(raw):
@@ -77,12 +80,27 @@ class NpmComparer(Comparer):
         text = constraint.strip()
         if text in ("", "*", "x", "X"):
             return [ALWAYS]
+        # comma-AND clauses (advisory-feed syntax) intersect, each
+        # parsed on its own so hyphen ranges survive inside them
+        # (same per-clause split as the pep440/rubygems grammars)
+        clauses = [c.strip() for c in text.split(",") if c.strip()]
+        if len(clauses) > 1:
+            union = [ALWAYS]
+            for clause in clauses:
+                union = intersect_unions(
+                    union, self.constraint_intervals(clause))
+            return union
         # hyphen range: "1.2.3 - 2.0.0"
         hm = re.match(r"^(\S+)\s+-\s+(\S+)$", text)
         if hm:
             lo = self._xparse(hm.group(1))
             hi = self._xparse(hm.group(2))
-            lo_iv = Interval(lo=lo[0]) if lo[0] is not None else ALWAYS
+            # _xparse yields bounds only for x-ranges ("1.2.x"); a
+            # full version is its own inclusive lower bound
+            if lo[0] is not None:
+                lo_iv = Interval(lo=lo[0])
+            else:
+                lo_iv = Interval(lo=self.parse(hm.group(1)))
             if hi[1] is not None:          # partial: <= upper fill
                 hi_iv = Interval(hi=hi[1], hi_incl=False)
             else:
@@ -117,7 +135,7 @@ class NpmComparer(Comparer):
         return result
 
     def _pre_allowed(self, tuple3, part: str) -> bool:
-        for tok in re.split(r"\s+", part.strip()):
+        for tok in re.split(r"[\s,]+", part.strip()):
             ver = tok.lstrip("^~=<>")
             m = _VERSION_RE.match(ver)
             if m and m.group("pre") is not None:

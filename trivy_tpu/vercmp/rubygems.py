@@ -13,7 +13,11 @@ import re
 from .base import ALWAYS, Comparer, Interval, intersect_unions
 
 _SEG_RE = re.compile(r"[0-9]+|[a-z]+", re.IGNORECASE)
-_VALID_RE = re.compile(r"^\s*([0-9]+(\.[0-9a-zA-Z]+)*(-[0-9A-Za-z-]+)?)?\s*$")
+# Gem::Version::VERSION_PATTERN — the dash prerelease may itself be
+# dotted ("3.4.4-beta.1")
+_VALID_RE = re.compile(
+    r"^\s*([0-9]+(\.[0-9a-zA-Z]+)*"
+    r"(-[0-9A-Za-z-]+(\.[0-9A-Za-z-]+)*)?)?\s*$")
 
 
 class _GemKey:
