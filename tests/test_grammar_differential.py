@@ -1,0 +1,117 @@
+"""Differential fuzz: host ``match`` vs the compiled interval path
+must agree for every grammar on generated constraint/version pairs —
+the invariant the TPU detection path rests on (the 1M-row scale run
+caught npm comma-ranges, hyphen-range bounds, and gem prereleases
+violating it)."""
+
+import random
+import zlib
+
+import pytest
+
+from trivy_tpu.db import AdvisoryStore, CompiledDB
+from trivy_tpu.vercmp import get_comparer
+from trivy_tpu.vercmp.base import is_vulnerable
+
+GRAMMARS = ("semver", "npm", "pep440", "rubygems", "maven")
+
+_BUCKETS = {"semver": "go::Go", "npm": "npm::Node.js",
+            "pep440": "pip::Python", "rubygems": "rubygems::Gems",
+            "maven": "maven::Maven"}
+
+
+def _version(rng) -> str:
+    v = f"{rng.randrange(4)}.{rng.randrange(6)}.{rng.randrange(6)}"
+    return v
+
+
+def _constraint(rng, grammar: str) -> list:
+    """GHSA-shaped VulnerableVersions lists."""
+    fixed = f"{rng.randrange(1, 4)}.{rng.randrange(6)}." \
+            f"{rng.randrange(1, 6)}"
+    roll = rng.random()
+    if roll < 0.4:
+        return [f"<{fixed}"]
+    if roll < 0.6:
+        lo = f"{rng.randrange(2)}.{rng.randrange(6)}.0"
+        return [f">={lo}, <{fixed}"]
+    if roll < 0.75:
+        # list entries are OR alternatives in trivy-db
+        lo = f"{rng.randrange(2)}.{rng.randrange(6)}.0"
+        return [f">= {lo}", f"<= {fixed}"]
+    if roll < 0.9:
+        alt = f"{rng.randrange(2, 5)}.{rng.randrange(6)}." \
+              f"{rng.randrange(1, 6)}"
+        return [f"<{fixed}", f">={fixed}, <{alt}"]
+    return [f"={fixed}"]
+
+
+@pytest.mark.parametrize("grammar", GRAMMARS)
+def test_host_vs_compiled_agree(grammar):
+    rng = random.Random(zlib.crc32(grammar.encode()))
+    comparer = get_comparer(grammar)
+    bucket = _BUCKETS[grammar]
+
+    cases = []
+    store = AdvisoryStore()
+    for i in range(120):
+        vulnerable = _constraint(rng, grammar)
+        patched_v = f"{rng.randrange(1, 4)}.{rng.randrange(6)}." \
+                    f"{rng.randrange(6)}"
+        patched = [f">={patched_v}"] if rng.random() < 0.7 else []
+        store.put_advisory(bucket, f"pkg{i}", f"CVE-{i}",
+                           {"VulnerableVersions": vulnerable,
+                            "PatchedVersions": patched})
+        cases.append((i, vulnerable, patched))
+
+    cdb = CompiledDB.compile(store)
+
+    mismatches = []
+    for i, vulnerable, patched in cases:
+        rows = list(cdb.candidate_rows(bucket, f"pkg{i}"))
+        assert len(rows) == 1
+        row = rows[0]
+        for _ in range(10):
+            version = _version(rng)
+            host = is_vulnerable(comparer, version, vulnerable,
+                                 patched, [])
+            # the compiled path: resident intervals when the row
+            # compiled, else the same host evaluator — both must
+            # match the classic host answer
+            from trivy_tpu.db.compiled import F_HOST
+            if int(cdb.flags[row]) & F_HOST:
+                device = cdb.host_eval(row, version)
+            else:
+                r = cdb.pkg_rank(grammar, version)
+                if r is None:
+                    continue
+                import numpy as np
+
+                from trivy_tpu.ops.intervals import \
+                    interval_hits_host
+                hit = interval_hits_host(
+                    np.asarray([r], np.int32),
+                    cdb.v_lo[[row]], cdb.v_hi[[row]],
+                    cdb.s_lo[[row]], cdb.s_hi[[row]],
+                    cdb.flags[[row]])
+                device = bool(hit[0])
+            if host != device:
+                mismatches.append(
+                    (version, vulnerable, patched, host, device))
+    assert not mismatches, mismatches[:5]
+
+
+@pytest.mark.parametrize("grammar", GRAMMARS)
+def test_compile_rate(grammar):
+    """GHSA-shaped constraints should compile onto the device tables,
+    not fall back (regression for the comma-range fallback)."""
+    rng = random.Random(1234)
+    store = AdvisoryStore()
+    for i in range(200):
+        store.put_advisory(
+            _BUCKETS[grammar], f"p{i}", f"CVE-{i}",
+            {"VulnerableVersions": _constraint(rng, grammar),
+             "PatchedVersions": [">=9.9.9"]})
+    cdb = CompiledDB.compile(store)
+    rate = cdb.stats["host_fallback_rate"]
+    assert rate <= 0.05, f"{grammar}: fallback {rate:.2%}"

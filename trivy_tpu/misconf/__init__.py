@@ -66,7 +66,7 @@ def _result(policy: Policy, file_type: str, message: str,
             cause=None) -> MisconfResult:
     return MisconfResult(
         namespace=f"builtin.{file_type}.{policy.id}",
-        query="data.builtin." + file_type,
+        query=f"data.builtin.{file_type}.{policy.id}.deny",
         message=message,
         id=policy.id,
         avd_id=policy.avd_id,

@@ -143,7 +143,7 @@ DOCKERFILE_POLICIES = [
            recommended_actions="Add a tag to the image in the 'FROM' "
            "statement",
            references=["https://avd.aquasec.com/misconfig/ds001"],
-           provider="Generic", service="general",
+           provider="Dockerfile", service="general",
            check=_check_latest_tag),
     Policy(id="DS002", avd_id="AVD-DS-0002",
            title="Image user should not be 'root'",
@@ -157,7 +157,7 @@ DOCKERFILE_POLICIES = [
            references=["https://docs.docker.com/develop/"
                        "develop-images/dockerfile_best-practices/",
                        "https://avd.aquasec.com/misconfig/ds002"],
-           provider="Generic", service="general",
+           provider="Dockerfile", service="general",
            check=_check_root_user),
     Policy(id="DS004", avd_id="AVD-DS-0004",
            title="Port 22 exposed",
@@ -167,7 +167,7 @@ DOCKERFILE_POLICIES = [
            recommended_actions="Remove 'EXPOSE 22' statement from "
            "the Dockerfile",
            references=["https://avd.aquasec.com/misconfig/ds004"],
-           provider="Generic", service="general",
+           provider="Dockerfile", service="general",
            check=_check_exposed_22),
     Policy(id="DS005", avd_id="AVD-DS-0005",
            title="ADD instead of COPY",
@@ -179,7 +179,7 @@ DOCKERFILE_POLICIES = [
            severity="LOW",
            recommended_actions="Use COPY instead of ADD",
            references=["https://avd.aquasec.com/misconfig/ds005"],
-           provider="Generic", service="general",
+           provider="Dockerfile", service="general",
            check=_check_add),
     Policy(id="DS026", avd_id="AVD-DS-0026",
            title="No HEALTHCHECK defined",
@@ -190,7 +190,7 @@ DOCKERFILE_POLICIES = [
            recommended_actions="Add HEALTHCHECK instruction in "
            "Dockerfile",
            references=["https://avd.aquasec.com/misconfig/ds026"],
-           provider="Generic", service="general",
+           provider="Dockerfile", service="general",
            check=_check_healthcheck),
 ]
 

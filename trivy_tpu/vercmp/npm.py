@@ -90,6 +90,8 @@ class NpmComparer(Comparer):
                 union = intersect_unions(
                     union, self.constraint_intervals(clause))
             return union
+        if clauses:
+            text = clauses[0]    # drop stray commas ("1.0 - 2.0,")
         # hyphen range: "1.2.3 - 2.0.0"
         hm = re.match(r"^(\S+)\s+-\s+(\S+)$", text)
         if hm:
