@@ -227,3 +227,124 @@ class TestAmazonLinux2022:
         r = a.analyze("usr/lib/system-release",
                       b"Amazon Linux release 2022 (Amazon Linux)\n")
         assert (r.os.family, r.os.name) == ("amazon", "2022")
+
+
+class TestSysfileFilter:
+    def test_os_managed_lang_pkgs_dropped(self):
+        """rpm/dpkg-owned python/gem files must not double-report
+        (ref handler/sysfile/filter.go)."""
+        from trivy_tpu.handler.sysfile import SystemFileFilterHandler
+        from trivy_tpu.types.artifact import (Application, BlobInfo,
+                                              Package)
+        blob = BlobInfo(
+            system_files=["/usr/lib/python3.9/site-packages/"
+                          "setuptools-53.0.0.dist-info/METADATA"],
+            applications=[
+                Application(type="python-pkg", libraries=[
+                    Package(name="setuptools", version="53.0.0",
+                            file_path="usr/lib/python3.9/"
+                            "site-packages/setuptools-53.0.0"
+                            ".dist-info/METADATA"),
+                    Package(name="requests", version="2.27.0",
+                            file_path="opt/app/requests-2.27.0"
+                            ".dist-info/METADATA")]),
+                Application(type="pip",
+                            file_path="app/requirements.txt",
+                            libraries=[Package(name="x",
+                                               version="1")]),
+            ])
+        SystemFileFilterHandler().handle(blob)
+        py = [a for a in blob.applications
+              if a.type == "python-pkg"][0]
+        assert [p.name for p in py.libraries] == ["requests"]
+        # lockfile apps are untouched
+        assert any(a.type == "pip" for a in blob.applications)
+
+
+class TestUnpackagedHandler:
+    def test_rekor_sbom_merge(self, monkeypatch):
+        """An unpackaged executable's digest resolves to a Rekor SBOM
+        attestation whose packages merge into the blob
+        (ref handler/unpackaged)."""
+        import base64
+        import json as json_mod
+        import threading
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        bom = {"bomFormat": "CycloneDX", "specVersion": "1.4",
+               "components": [
+                   {"bom-ref": "r", "type": "library",
+                    "name": "github.com/gin-gonic/gin",
+                    "version": "v1.7.7",
+                    "purl": "pkg:golang/github.com/gin-gonic/"
+                            "gin@v1.7.7"}]}
+        stmt = json_mod.dumps({
+            "predicateType": "https://cyclonedx.org/bom",
+            "predicate": {"Data": bom}}).encode()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json_mod.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/api/v1/index/retrieve":
+                    out = ["c" * 64]
+                else:
+                    out = [{u: {"attestation": {
+                        "data": base64.b64encode(stmt).decode()}}}
+                        for u in body.get("entryUUIDs", [])]
+                d = json_mod.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(d)))
+                self.end_headers()
+                self.wfile.write(d)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        monkeypatch.setenv(
+            "TRIVY_REKOR_URL",
+            f"http://127.0.0.1:{httpd.server_address[1]}")
+        try:
+            from trivy_tpu.handler.unpackaged import (
+                DIGEST_RESOURCE, UnpackagedHandler)
+            from trivy_tpu.types.artifact import (BlobInfo,
+                                                  CustomResource)
+            blob = BlobInfo(custom_resources=[CustomResource(
+                type=DIGEST_RESOURCE, file_path="usr/bin/server",
+                data={"digest": "sha256:" + "ab" * 32})])
+            UnpackagedHandler().handle(blob)
+            libs = [lib.name for a in blob.applications
+                    for lib in a.libraries]
+            assert "github.com/gin-gonic/gin" in libs
+            assert blob.custom_resources == []   # plumbing consumed
+        finally:
+            httpd.shutdown()
+
+    def test_noop_without_rekor_url(self, monkeypatch):
+        monkeypatch.delenv("TRIVY_REKOR_URL", raising=False)
+        from trivy_tpu.handler.unpackaged import (DIGEST_RESOURCE,
+                                                  UnpackagedHandler)
+        from trivy_tpu.types.artifact import (BlobInfo,
+                                              CustomResource)
+        blob = BlobInfo(custom_resources=[CustomResource(
+            type=DIGEST_RESOURCE, file_path="x",
+            data={"digest": "sha256:00"})])
+        UnpackagedHandler().handle(blob)
+        assert blob.applications == []
+        assert blob.custom_resources == []
+
+    def test_digest_analyzer_gated(self, monkeypatch):
+        from trivy_tpu.analyzer.binary import \
+            ExecutableDigestAnalyzer
+        a = ExecutableDigestAnalyzer()
+        monkeypatch.delenv("TRIVY_REKOR_URL", raising=False)
+        assert not a.required("usr/bin/app", 10000)
+        monkeypatch.setenv("TRIVY_REKOR_URL", "http://x")
+        assert a.required("usr/bin/app", 10000)
+        r = a.analyze("usr/bin/app", b"\x7fELF" + b"\x00" * 64)
+        assert r.custom_resources[0].data["digest"].startswith(
+            "sha256:")

@@ -200,3 +200,34 @@ class RustBinaryAnalyzer(Analyzer):
         if not pkgs:
             return AnalysisResult()
         return _app("rustbinary", path, pkgs)
+
+
+@register_analyzer
+class ExecutableDigestAnalyzer(Analyzer):
+    """Digests for unpackaged executables (reference: the executable
+    analyzer feeding AnalysisResult.Digests for the unpackaged
+    handler's Rekor lookups). Active only when a Rekor URL is
+    configured — hashing every binary costs real time otherwise."""
+
+    type = "executable-digest"
+    version = 1
+
+    def required(self, path: str, size: Optional[int] = None) -> bool:
+        import os
+        if not os.environ.get("TRIVY_REKOR_URL"):
+            return False
+        return _binary_required(path, size)
+
+    def analyze(self, path: str, content: bytes) -> AnalysisResult:
+        r = AnalysisResult()
+        if not _looks_executable(content):
+            return r
+        import hashlib
+
+        from ..types.artifact import (DIGEST_RESOURCE_TYPE,
+                                      CustomResource)
+        r.custom_resources.append(CustomResource(
+            type=DIGEST_RESOURCE_TYPE, file_path=path,
+            data={"digest":
+                  "sha256:" + hashlib.sha256(content).hexdigest()}))
+        return r

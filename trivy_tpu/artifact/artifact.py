@@ -76,12 +76,17 @@ class ImageArtifact:
 
     def inspect(self) -> ArtifactReference:
         img = self.image
+        import os as _os
         opts_key = {"skip_dirs": self.opt.skip_dirs,
                     "skip_files": self.opt.skip_files,
                     "patterns": sorted(self.opt.file_patterns),
                     "secrets": self.opt.scan_secrets,
                     "misconfig": self.opt.scan_misconfig,
-                    "licenses": self.opt.scan_licenses}
+                    "licenses": self.opt.scan_licenses,
+                    # rekor toggling changes analyzer output, so it
+                    # must invalidate cached blobs
+                    "rekor": bool(_os.environ.get(
+                        "TRIVY_REKOR_URL"))}
         versions = dict(self.group.versions())
         versions.update({f"handler/{k}": v
                          for k, v in handler_versions().items()})

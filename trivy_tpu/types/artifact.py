@@ -247,6 +247,12 @@ class ArtifactInfo:
         return asdict_omitempty(self)
 
 
+# the type string the executable-digest analyzer emits and the
+# unpackaged post-handler consumes — shared so producer/consumer
+# can't drift
+DIGEST_RESOURCE_TYPE = "executable-digest"
+
+
 @dataclass
 class ArtifactReference:
     """What Artifact.Inspect returns (reference: fanal artifact.go:44-47)."""
