@@ -118,7 +118,8 @@ class TestKubernetesPolicies:
         mc = out[0]
         assert mc.file_type == "kubernetes"
         ids = {r.id for r in mc.failures}
-        assert ids == {"KSV001", "KSV006", "KSV012", "KSV017"}
+        assert ids == {"KSV001", "KSV006", "KSV012", "KSV014",
+                       "KSV017"}
 
     def test_hardened_pod(self):
         content = b"""apiVersion: v1
@@ -132,6 +133,7 @@ spec:
         privileged: false
         allowPrivilegeEscalation: false
         runAsNonRoot: true
+        readOnlyRootFilesystem: true
 """
         out = scan_config_files([ConfigFile(
             type="yaml", file_path="pod.yaml", content=content)])
@@ -209,7 +211,7 @@ class TestEndToEnd:
             "https://avd.aquasec.com/misconfig/ds002"
         pod = by_target["pod.yaml"]
         assert pod["Type"] == "kubernetes"
-        assert pod["MisconfSummary"]["Failures"] == 4
+        assert pod["MisconfSummary"]["Failures"] == 5
 
     def test_include_non_failures(self, tmp_path):
         (tmp_path / "app").mkdir()
@@ -375,3 +377,71 @@ class TestReferenceGoldenParity:
         our_fail_ids = {m["ID"] for m in
                         orr.get("Misconfigurations", [])}
         assert golden_fail_ids <= our_fail_ids
+
+
+class TestNewKsvPolicies:
+    def test_ksv029_root_gid(self):
+        content = b"""apiVersion: v1
+kind: Pod
+metadata: {name: web}
+spec:
+  securityContext: {fsGroup: 0}
+  containers:
+    - name: app
+      securityContext: {runAsGroup: 0}
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=content)])
+        assert "KSV029" in {r.id for r in out[0].failures}
+
+    def test_ksv029_nonzero_gid_passes(self):
+        content = b"""apiVersion: v1
+kind: Pod
+metadata: {name: web}
+spec:
+  securityContext: {fsGroup: 1000}
+  containers:
+    - name: app
+      securityContext: {runAsGroup: 1000}
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=content)])
+        assert "KSV029" not in {r.id for r in out[0].failures}
+
+
+class TestRekorCacheKey:
+    def test_rekor_env_changes_blob_keys(self, monkeypatch):
+        """Toggling TRIVY_REKOR_URL must invalidate cached blobs
+        (review finding: analyzer output depends on it)."""
+        from trivy_tpu.artifact import ArtifactOption, ImageArtifact
+        from trivy_tpu.artifact.cache import MemoryCache
+        from trivy_tpu.artifact.image import load_image
+        import tests.test_e2e_image as e2e
+        import pathlib, tempfile
+        with tempfile.TemporaryDirectory() as tmp:
+            img_path = e2e.make_image_tar(
+                pathlib.Path(tmp),
+                [{"etc/alpine-release": b"3.16.0\n"}])
+            monkeypatch.delenv("TRIVY_REKOR_URL", raising=False)
+            a = ImageArtifact(load_image(img_path), MemoryCache(),
+                              ArtifactOption(scan_secrets=False))
+            ref_off = a.inspect()
+            monkeypatch.setenv("TRIVY_REKOR_URL", "http://x")
+            a = ImageArtifact(load_image(img_path), MemoryCache(),
+                              ArtifactOption(scan_secrets=False))
+            ref_on = a.inspect()
+        assert ref_off.blob_ids != ref_on.blob_ids
+
+    def test_ksv029_supplemental_root_group(self):
+        content = b"""apiVersion: v1
+kind: Pod
+metadata: {name: web}
+spec:
+  securityContext: {supplementalGroups: [0]}
+  containers:
+    - name: app
+      securityContext: {runAsGroup: 1000}
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=content)])
+        assert "KSV029" in {r.id for r in out[0].failures}

@@ -83,10 +83,11 @@ class ImageArtifact:
                     "secrets": self.opt.scan_secrets,
                     "misconfig": self.opt.scan_misconfig,
                     "licenses": self.opt.scan_licenses,
-                    # rekor toggling changes analyzer output, so it
-                    # must invalidate cached blobs
-                    "rekor": bool(_os.environ.get(
-                        "TRIVY_REKOR_URL"))}
+                    # the rekor URL changes analyzer/handler output
+                    # (different servers hold different
+                    # attestations), so it keys cached blobs
+                    "rekor": _os.environ.get(
+                        "TRIVY_REKOR_URL", "")}
         versions = dict(self.group.versions())
         versions.update({f"handler/{k}": v
                          for k, v in handler_versions().items()})

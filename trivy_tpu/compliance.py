@@ -91,14 +91,12 @@ NSA_SPEC = {
             {"id": "1.0", "name": "Non-root containers",
              "checks": [{"id": "KSV012"}], "severity": "MEDIUM"},
             {"id": "1.2", "name": "Immutable container file systems",
-             "checks": [{"id": "KSV014"}], "severity": "LOW",
-             "defaultStatus": "FAIL"},
+             "checks": [{"id": "KSV014"}], "severity": "LOW"},
             {"id": "1.4", "name": "Privileged",
              "checks": [{"id": "KSV017"}], "severity": "HIGH"},
             {"id": "1.6", "name": "Run with root privileges or with "
              "root group membership",
-             "checks": [{"id": "KSV029"}], "severity": "LOW",
-             "defaultStatus": "FAIL"},
+             "checks": [{"id": "KSV029"}], "severity": "LOW"},
             {"id": "1.7", "name": "hostPath mount",
              "checks": [{"id": "KSV006"}], "severity": "MEDIUM"},
             {"id": "1.9", "name": "Privilege escalation",

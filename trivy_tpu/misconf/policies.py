@@ -256,6 +256,42 @@ def _k8s_check_run_as_nonroot(doc: dict) -> list:
     return causes
 
 
+def _k8s_check_readonly_rootfs(doc: dict) -> list:
+    causes = []
+    for c, _ in _k8s_containers(doc):
+        sc = c.get("securityContext") or {}
+        if not sc.get("readOnlyRootFilesystem"):
+            causes.append(Cause(
+                message=f"Container {c.get('name', '?')!r} of "
+                f"{doc.get('kind', '?')} "
+                f"{(doc.get('metadata') or {}).get('name', '?')!r} "
+                "should set 'securityContext."
+                "readOnlyRootFilesystem' to true",
+                resource=c.get("name", "")))
+    return causes
+
+
+def _k8s_check_run_as_root_group(doc: dict) -> list:
+    """KSV029: explicit root primary (runAsGroup/fsGroup 0) or
+    supplementary (supplementalGroups containing 0) GID."""
+    causes = []
+    for c, pod in _k8s_containers(doc):
+        csc = c.get("securityContext") or {}
+        psc = pod.get("securityContext") or {}
+        group = csc.get("runAsGroup", psc.get("runAsGroup"))
+        fs_group = psc.get("fsGroup")
+        supplemental = psc.get("supplementalGroups") or []
+        if group == 0 or fs_group == 0 or 0 in supplemental:
+            causes.append(Cause(
+                message=f"Container {c.get('name', '?')!r} of "
+                f"{doc.get('kind', '?')} "
+                f"{(doc.get('metadata') or {}).get('name', '?')!r} "
+                "should not set 'securityContext.runAsGroup' or "
+                "'fsGroup' to 0",
+                resource=c.get("name", "")))
+    return causes
+
+
 def _k8s_check_docker_sock(doc: dict) -> list:
     causes = []
     spec = doc.get("spec") or {}
@@ -303,6 +339,26 @@ KUBERNETES_POLICIES = [
            references=["https://avd.aquasec.com/misconfig/ksv012"],
            provider="Kubernetes", service="general",
            check=_k8s_check_run_as_nonroot),
+    Policy(id="KSV014", avd_id="AVD-KSV-0014",
+           title="Root file system is not read-only",
+           description="An immutable root file system prevents "
+           "applications from writing to their local disk.",
+           severity="LOW",
+           recommended_actions="Change 'containers[].securityContext"
+           ".readOnlyRootFilesystem' to 'true'.",
+           references=["https://avd.aquasec.com/misconfig/ksv014"],
+           provider="Kubernetes", service="general",
+           check=_k8s_check_readonly_rootfs),
+    Policy(id="KSV029", avd_id="AVD-KSV-0029",
+           title="A root primary or supplementary GID set",
+           description="Containers should be forbidden from running "
+           "with a root primary or supplementary GID.",
+           severity="LOW",
+           recommended_actions="Set 'securityContext.runAsGroup' and "
+           "'fsGroup' to a non-zero GID.",
+           references=["https://avd.aquasec.com/misconfig/ksv029"],
+           provider="Kubernetes", service="general",
+           check=_k8s_check_run_as_root_group),
     Policy(id="KSV017", avd_id="AVD-KSV-0017",
            title="Privileged container",
            description="Privileged containers share namespaces with "
