@@ -236,7 +236,7 @@ class TestCompliance:
         by_id = {c["ID"]: c for c in doc["Controls"]}
         assert by_id["1.4"]["Status"] == "FAIL"
         assert by_id["1.4"]["FailTotal"] >= 1
-        # a control with no implemented check honors defaultStatus
+        # KSV014 is a real check now: the fixture pod fails it
         assert by_id["1.2"]["Status"] == "FAIL"
 
     def test_custom_spec_file(self, manifests, tmp_path):
@@ -262,3 +262,51 @@ class TestCompliance:
         doc = json.loads(out_file.read_text())
         assert doc["ID"] == "custom"
         assert doc["Controls"][0]["Status"] == "FAIL"
+
+
+class TestDefaultStatus:
+    def test_unimplemented_check_honors_default(self, manifests,
+                                                tmp_path):
+        """A control whose check has no implementation reports via
+        defaultStatus (the branch the NSA spec no longer exercises
+        now that KSV014/KSV029 are real)."""
+        spec = tmp_path / "spec.yaml"
+        spec.write_text("""spec:
+  id: ds
+  title: default-status spec
+  version: "1"
+  controls:
+    - id: X-1
+      name: not implemented anywhere
+      checks:
+        - id: KSV999
+      severity: LOW
+      defaultStatus: FAIL
+    - id: X-2
+      name: also unimplemented, no default
+      checks:
+        - id: KSV998
+      severity: LOW
+""")
+        out_file = tmp_path / "r.json"
+        code, _ = self._run([
+            "k8s", str(manifests), "--security-checks", "config",
+            "--backend", "cpu", "--compliance", str(spec),
+            "--format", "json", "--output", str(out_file),
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        doc = json.loads(out_file.read_text())
+        by_id = {c["ID"]: c for c in doc["Controls"]}
+        assert by_id["X-1"]["Status"] == "FAIL"
+        assert by_id["X-1"]["FailTotal"] == 1
+        assert by_id["X-2"]["Status"] == "PASS"
+
+    def _run(self, argv):
+        import contextlib
+        import io
+
+        from trivy_tpu.cli import main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(argv)
+        return code, buf.getvalue()

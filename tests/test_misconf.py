@@ -82,8 +82,7 @@ class TestDockerfilePolicies:
     def test_good_dockerfile_passes(self):
         mc = self._scan(GOOD_DOCKERFILE)
         assert mc.failures == []
-        assert {r.id for r in mc.successes} == \
-            {"DS001", "DS002", "DS004", "DS005", "DS026"}
+        assert {r.id for r in mc.successes} == {"DS001", "DS002", "DS004", "DS005", "DS006", "DS007", "DS008", "DS009", "DS010", "DS013", "DS016", "DS017", "DS022", "DS023", "DS025", "DS026"}
 
     def test_missing_user(self):
         mc = self._scan(b"FROM alpine:3.16\nRUN true\n")
@@ -227,7 +226,7 @@ class TestEndToEnd:
         assert code == 0
         report = json.loads(out_file.read_text())
         r = report["Results"][0]
-        assert r["MisconfSummary"]["Successes"] == 5
+        assert r["MisconfSummary"]["Successes"] == 16
         assert all(m["Status"] == "PASS"
                    for m in r["Misconfigurations"])
 
@@ -278,7 +277,7 @@ class TestEndToEnd:
         assert code == 0
         report = json.loads(out_file.read_text())
         assert report["Results"][0]["MisconfSummary"][
-            "Successes"] == 5
+            "Successes"] == 16
         assert "Misconfigurations" not in report["Results"][0]
 
     def test_container_level_run_as_nonroot_false(self):
@@ -408,6 +407,21 @@ spec:
             type="yaml", file_path="pod.yaml", content=content)])
         assert "KSV029" not in {r.id for r in out[0].failures}
 
+    def test_ksv029_supplemental_root_group(self):
+        content = b"""apiVersion: v1
+kind: Pod
+metadata: {name: web}
+spec:
+  securityContext: {supplementalGroups: [0]}
+  containers:
+    - name: app
+      securityContext: {runAsGroup: 1000}
+"""
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="pod.yaml", content=content)])
+        assert "KSV029" in {r.id for r in out[0].failures}
+
+
 
 class TestRekorCacheKey:
     def test_rekor_env_changes_blob_keys(self, monkeypatch):
@@ -432,16 +446,75 @@ class TestRekorCacheKey:
             ref_on = a.inspect()
         assert ref_off.blob_ids != ref_on.blob_ids
 
-    def test_ksv029_supplemental_root_group(self):
-        content = b"""apiVersion: v1
-kind: Pod
-metadata: {name: web}
-spec:
-  securityContext: {supplementalGroups: [0]}
-  containers:
-    - name: app
-      securityContext: {runAsGroup: 1000}
-"""
-        out = scan_config_files([ConfigFile(
-            type="yaml", file_path="pod.yaml", content=content)])
-        assert "KSV029" in {r.id for r in out[0].failures}
+
+class TestExtendedDockerfilePolicies:
+    def _fails(self, content):
+        mc = scan_config_files([ConfigFile(
+            type="dockerfile", file_path="Dockerfile",
+            content=content)])[0]
+        return {r.id for r in mc.failures}
+
+    def test_ds006_copy_from_self(self):
+        ids = self._fails(
+            b"FROM alpine:3.16 AS build\n"
+            b"COPY --from=build /x /y\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS006" in ids
+
+    def test_ds007_ds016_ds023_duplicates(self):
+        ids = self._fails(
+            b"FROM alpine:3.16\nENTRYPOINT [\"/a\"]\n"
+            b"ENTRYPOINT [\"/b\"]\nCMD [\"x\"]\nCMD [\"y\"]\n"
+            b"HEALTHCHECK CMD a\nHEALTHCHECK CMD b\nUSER app\n")
+        assert {"DS007", "DS016", "DS023"} <= ids
+
+    def test_ds008_port_range(self):
+        assert "DS008" in self._fails(
+            b"FROM alpine:3.16\nEXPOSE 99999\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS008" not in self._fails(
+            b"FROM alpine:3.16\nEXPOSE 8080/tcp\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+
+    def test_ds009_relative_workdir(self):
+        assert "DS009" in self._fails(
+            b"FROM alpine:3.16\nWORKDIR app\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS009" not in self._fails(
+            b"FROM alpine:3.16\nWORKDIR /app\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+
+    def test_ds010_sudo(self):
+        assert "DS010" in self._fails(
+            b"FROM alpine:3.16\nRUN sudo apk add curl\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+
+    def test_ds013_run_cd(self):
+        assert "DS013" in self._fails(
+            b"FROM alpine:3.16\nRUN cd /tmp\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        # cd combined with a real command is fine
+        assert "DS013" not in self._fails(
+            b"FROM alpine:3.16\nRUN cd /tmp && make\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+
+    def test_ds017_apt_y(self):
+        assert "DS017" in self._fails(
+            b"FROM debian:11\nRUN apt-get install curl\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS017" not in self._fails(
+            b"FROM debian:11\nRUN apt-get install -y curl\n"
+            b"USER app\nHEALTHCHECK CMD true\n")
+
+    def test_ds022_maintainer(self):
+        assert "DS022" in self._fails(
+            b"FROM alpine:3.16\nMAINTAINER someone\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+
+    def test_ds025_apk_no_cache(self):
+        assert "DS025" in self._fails(
+            b"FROM alpine:3.16\nRUN apk add curl\nUSER app\n"
+            b"HEALTHCHECK CMD true\n")
+        assert "DS025" not in self._fails(
+            b"FROM alpine:3.16\nRUN apk add --no-cache curl\n"
+            b"USER app\nHEALTHCHECK CMD true\n")

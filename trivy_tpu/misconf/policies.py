@@ -8,7 +8,9 @@ reference embeds these in defsec's Go checks). Each policy's
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Callable
 
 from .dockerfile import Stage
@@ -370,4 +372,271 @@ KUBERNETES_POLICIES = [
            references=["https://avd.aquasec.com/misconfig/ksv017"],
            provider="Kubernetes", service="general",
            check=_k8s_check_privileged),
+]
+
+
+def _check_copy_from_self(stages: list) -> list:
+    """DS006: COPY --from references the stage's own FROM alias."""
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd != "COPY":
+                continue
+            for flag in inst.flags:
+                if flag.startswith("--from=") and stage.alias and \
+                        flag[len("--from="):].lower() == \
+                        stage.alias.lower():
+                    causes.append(Cause(
+                        message=f"'COPY {flag}' references the "
+                        f"current image FROM alias "
+                        f"{stage.alias!r}",
+                        start_line=inst.start_line,
+                        end_line=inst.end_line))
+    return causes
+
+
+def _check_duplicate(cmd: str, stages: list) -> list:
+    """Per stage, every occurrence of ``cmd`` but the last is dead."""
+    causes = []
+    for stage in stages:
+        insts = [i for i in stage.instructions if i.cmd == cmd]
+        for inst in insts[:-1]:
+            causes.append(Cause(
+                message=f"There are multiple {cmd} instructions; "
+                "only the last one takes effect",
+                start_line=inst.start_line,
+                end_line=inst.end_line))
+    return causes
+
+
+def _check_port_range(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd != "EXPOSE":
+                continue
+            for port in inst.value.split():
+                num = port.split("/")[0]
+                if num.isdigit() and int(num) > 65535:
+                    causes.append(Cause(
+                        message=f"'EXPOSE' contains port "
+                        f"{num} which is out of range",
+                        start_line=inst.start_line,
+                        end_line=inst.end_line))
+    return causes
+
+
+def _check_workdir_relative(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd != "WORKDIR":
+                continue
+            path = inst.value.strip().strip("'\"")
+            if path and not path.startswith(("/", "$", "C:",
+                                             "c:")):
+                causes.append(Cause(
+                    message=f"WORKDIR path {path!r} should be "
+                    "absolute",
+                    start_line=inst.start_line,
+                    end_line=inst.end_line))
+    return causes
+
+
+def _check_run_sudo(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd == "RUN" and re.search(
+                    r"(^|\s|;|&&)sudo\s", " " + inst.value):
+                causes.append(Cause(
+                    message="Using 'sudo' in RUN is not supported "
+                    "and indicates a misconfigured image",
+                    start_line=inst.start_line,
+                    end_line=inst.end_line))
+    return causes
+
+
+def _check_run_cd(stages: list) -> list:
+    """DS013: use WORKDIR, not 'RUN cd ...' as the only command."""
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd == "RUN" and re.match(
+                    r"^cd\s+\S+$", inst.value.strip()):
+                causes.append(Cause(
+                    message=f"RUN should not be used to change "
+                    f"directories ('{inst.value}'); use WORKDIR",
+                    start_line=inst.start_line,
+                    end_line=inst.end_line))
+    return causes
+
+
+def _check_apt_install_y(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd != "RUN":
+                continue
+            for part in re.split(r"&&|;|\|", inst.value):
+                tokens = part.split()
+                if "apt-get" not in tokens and "apt" not in tokens:
+                    continue
+                if "install" not in tokens:
+                    continue
+                confirmed = any(
+                    t in ("--yes", "--assume-yes") or
+                    (t.startswith("-") and not t.startswith("--")
+                     and "y" in t[1:])
+                    for t in tokens)
+                if not confirmed:
+                    causes.append(Cause(
+                        message="'-y' flag is missing from "
+                        "'apt-get install' — the build will hang "
+                        "on the confirmation prompt",
+                        start_line=inst.start_line,
+                        end_line=inst.end_line))
+    return causes
+
+
+def _check_apk_no_cache(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd != "RUN":
+                continue
+            for part in re.split(r"&&|;|\|", inst.value):
+                tokens = part.split()
+                if "apk" in tokens and "add" in tokens and \
+                        "--no-cache" not in tokens:
+                    causes.append(Cause(
+                        message="'--no-cache' is missing from "
+                        "'apk add' — the package index bloats the "
+                        "image",
+                        start_line=inst.start_line,
+                        end_line=inst.end_line))
+    return causes
+
+
+def _check_maintainer(stages: list) -> list:
+    causes = []
+    for stage in stages:
+        for inst in stage.instructions:
+            if inst.cmd == "MAINTAINER":
+                causes.append(Cause(
+                    message=f"MAINTAINER is deprecated; use "
+                    f"'LABEL maintainer=\"{inst.value}\"'",
+                    start_line=inst.start_line,
+                    end_line=inst.end_line))
+    return causes
+
+
+DOCKERFILE_POLICIES += [
+    Policy(id="DS006", avd_id="AVD-DS-0006",
+           title="COPY '--from' references current FROM alias",
+           description="COPY '--from' should not mention the "
+           "current FROM alias, since it is impossible to copy from "
+           "itself.",
+           severity="CRITICAL",
+           recommended_actions="Change the '--from' so that it "
+           "references a previous build stage",
+           references=["https://avd.aquasec.com/misconfig/ds006"],
+           provider="Dockerfile", service="general",
+           check=_check_copy_from_self),
+    Policy(id="DS007", avd_id="AVD-DS-0007",
+           title="Multiple ENTRYPOINT instructions listed",
+           description="There can only be one ENTRYPOINT "
+           "instruction in a Dockerfile; only the last one takes "
+           "effect.",
+           severity="CRITICAL",
+           recommended_actions="Remove unnecessary ENTRYPOINT "
+           "instructions",
+           references=["https://avd.aquasec.com/misconfig/ds007"],
+           provider="Dockerfile", service="general",
+           check=partial(_check_duplicate, "ENTRYPOINT")),
+    Policy(id="DS008", avd_id="AVD-DS-0008",
+           title="Port out of range",
+           description="UNIX ports outside the 0-65535 range are "
+           "invalid.",
+           severity="CRITICAL",
+           recommended_actions="Use a port number within the range",
+           references=["https://avd.aquasec.com/misconfig/ds008"],
+           provider="Dockerfile", service="general",
+           check=_check_port_range),
+    Policy(id="DS009", avd_id="AVD-DS-0009",
+           title="WORKDIR path not absolute",
+           description="For clarity and reliability, you should "
+           "always use absolute paths for your WORKDIR.",
+           severity="HIGH",
+           recommended_actions="Use an absolute path in WORKDIR",
+           references=["https://avd.aquasec.com/misconfig/ds009"],
+           provider="Dockerfile", service="general",
+           check=_check_workdir_relative),
+    Policy(id="DS010", avd_id="AVD-DS-0010",
+           title="RUN using 'sudo'",
+           description="Avoid using 'sudo' in RUN: it has "
+           "unpredictable TTY and signal-forwarding behavior.",
+           severity="CRITICAL",
+           recommended_actions="Don't use sudo; switch users with "
+           "USER",
+           references=["https://avd.aquasec.com/misconfig/ds010"],
+           provider="Dockerfile", service="general",
+           check=_check_run_sudo),
+    Policy(id="DS013", avd_id="AVD-DS-0013",
+           title="'RUN cd ...' to change directory",
+           description="Use WORKDIR instead of proliferating "
+           "'RUN cd ...' instructions, which are hard to read and "
+           "maintain.",
+           severity="MEDIUM",
+           recommended_actions="Use WORKDIR to change directories",
+           references=["https://avd.aquasec.com/misconfig/ds013"],
+           provider="Dockerfile", service="general",
+           check=_check_run_cd),
+    Policy(id="DS016", avd_id="AVD-DS-0016",
+           title="Multiple CMD instructions listed",
+           description="There can only be one CMD instruction in a "
+           "Dockerfile; only the last one takes effect.",
+           severity="HIGH",
+           recommended_actions="Remove unnecessary CMD instructions",
+           references=["https://avd.aquasec.com/misconfig/ds016"],
+           provider="Dockerfile", service="general",
+           check=partial(_check_duplicate, "CMD")),
+    Policy(id="DS017", avd_id="AVD-DS-0017",
+           title="'apt-get install' missing '-y'",
+           description="Without '-y', apt-get waits for manual "
+           "confirmation and the build hangs.",
+           severity="HIGH",
+           recommended_actions="Add '-y' to 'apt-get install'",
+           references=["https://avd.aquasec.com/misconfig/ds017"],
+           provider="Dockerfile", service="general",
+           check=_check_apt_install_y),
+    Policy(id="DS022", avd_id="AVD-DS-0022",
+           title="MAINTAINER is deprecated",
+           description="The MAINTAINER instruction is deprecated "
+           "since Docker 1.13.0.",
+           severity="LOW",
+           recommended_actions="Use LABEL maintainer=... instead",
+           references=["https://avd.aquasec.com/misconfig/ds022"],
+           provider="Dockerfile", service="general",
+           check=_check_maintainer),
+    Policy(id="DS023", avd_id="AVD-DS-0023",
+           title="Multiple HEALTHCHECK instructions listed",
+           description="There can only be one HEALTHCHECK "
+           "instruction in a Dockerfile; only the last one takes "
+           "effect.",
+           severity="MEDIUM",
+           recommended_actions="Remove unnecessary HEALTHCHECK "
+           "instructions",
+           references=["https://avd.aquasec.com/misconfig/ds023"],
+           provider="Dockerfile", service="general",
+           check=partial(_check_duplicate, "HEALTHCHECK")),
+    Policy(id="DS025", avd_id="AVD-DS-0025",
+           title="'apk add' missing '--no-cache'",
+           description="Cached package indexes bloat the image; "
+           "'apk add --no-cache' avoids them.",
+           severity="HIGH",
+           recommended_actions="Add '--no-cache' to 'apk add'",
+           references=["https://avd.aquasec.com/misconfig/ds025"],
+           provider="Dockerfile", service="general",
+           check=_check_apk_no_cache),
 ]
