@@ -197,3 +197,34 @@ class TestPlan:
         for rp in plan.rules:
             if s.rules[rp.rule_index].keywords:
                 assert rp.gate
+
+
+class TestChainRunGates:
+    """run_gates chain combining: consecutive classifiable parts give
+    a contiguous-run necessary condition (round 4)."""
+
+    def test_dashed_digit_chain(self):
+        from trivy_tpu.secret.rx.anchor import run_gates
+        gates = run_gates(parse(r"[0-9]{4}\-?[0-9]{4}\-?[0-9]{4}"))
+        assert any(rl == 12 and bs == frozenset(b"0123456789-")
+                   for bs, rl in gates)
+
+    def test_unbounded_interior_breaks_chain(self):
+        from trivy_tpu.secret.rx.anchor import run_gates
+        # \s* between the runs can inject non-set bytes: no 8-run
+        gates = run_gates(parse(r"[0-9]{4}\s*[0-9]{4}"))
+        assert not any(rl >= 8 for _, rl in gates)
+
+    def test_broad_short_chain_rejected(self):
+        from trivy_tpu.secret.rx.anchor import run_gates
+        # 8 bytes but a ~64-wide class: below MIN_RUN_GATE and too
+        # broad for the chain threshold
+        gates = run_gates(parse(r"[0-9a-zA-Z+/]{8}"))
+        assert gates == []
+
+    def test_exact_flag(self):
+        # bounded, no elastic edges, no ^/$ → extraction-exact
+        assert analyze_rule(r"ghp_[0-9a-zA-Z]{36}").exact
+        # elastic edge stripped → detection-only window
+        ra = analyze_rule(r"(^|\s+)AKIA[0-9A-Z]{16}")
+        assert ra.anchored and not ra.exact

@@ -123,16 +123,20 @@ class BatchSecretScanner:
 
         t0 = _time.perf_counter()
         results = []
-        rules_verified = 0
+        rules_verified = windowed = wholefile = 0
         for fe in entries:
-            rule_idxs = candidates.get(fe.index)
-            if not rule_idxs:
+            chosen = candidates.get(fe.index)
+            if not chosen:
                 continue
-            rules_verified += len(rule_idxs)
-            rules = [self.scanner.rules[i] for i in sorted(rule_idxs)]
+            rules_verified += len(chosen)
+            idxs = sorted(chosen)
+            rules = [self.scanner.rules[i] for i in idxs]
+            regions = [chosen[i] for i in idxs]
+            windowed += sum(1 for r in regions if r is not None)
+            wholefile += sum(1 for r in regions if r is None)
             sub = Scanner(rules, self.scanner.allow_rules,
                           self.scanner.exclude_block)
-            secret = sub.scan(fe.path, fe.content)
+            secret = sub.scan(fe.path, fe.content, regions=regions)
             if secret.findings:
                 results.append((fe.index, secret))
         verify_s = _time.perf_counter() - t0
@@ -142,6 +146,8 @@ class BatchSecretScanner:
             "bytes_total": sum(len(fe.content) for fe in entries),
             "files_gated": len(candidates),
             "rules_verified": rules_verified,
+            "rules_windowed": windowed,
+            "rules_wholefile": wholefile,
             "files_with_findings": len(results),
             "sieve_s": round(sieve_s, 4),
             "device_s": round(self._device_s, 4),
@@ -152,8 +158,11 @@ class BatchSecretScanner:
     # --- sieve stages ---
 
     def _candidates(self, entries: list) -> dict:
-        """file index → set of rule indices that must be scanned
-        exactly."""
+        """file index → {rule index: verify spans or None}.
+
+        A rule maps to merged byte spans when its window proof is
+        extraction-exact (the host then regexes only those spans); to
+        None when it needs the reference's whole-file scan."""
         import time as _time
         self._device_s = 0.0
         buf, seg_file, seg_pos = self._segment(entries)
@@ -197,7 +206,7 @@ class BatchSecretScanner:
                   if not rp.gate and not rp.anchored]
         if always:
             for fe in entries:
-                sel = {rp.rule_index for rp in always
+                sel = {rp.rule_index: None for rp in always
                        if runs_pass(rp, fe.index)}
                 if sel:
                     out[fe.index] = sel
@@ -205,20 +214,25 @@ class BatchSecretScanner:
         for fidx, codes in file_codes.items():
             fe = by_index[fidx]
             hit = set(codes)
-            chosen = set(out.get(fidx, ()))
+            chosen = dict(out.get(fidx, ()))
             for rp in self.plan.rules:
                 if rp.gate and not (hit & rp.gate):
                     continue
                 if not rp.anchored:
                     if runs_pass(rp, fidx):
-                        chosen.add(rp.rule_index)
+                        chosen[rp.rule_index] = None
                     continue
                 anchor_hits = [h for a in rp.anchors
                                for h in codes.get(a, ())]
                 if not anchor_hits:
                     continue
-                if self._prelim(fe, rp, anchor_hits, blk):
-                    chosen.add(rp.rule_index)
+                spans = self._windows(fe, rp, anchor_hits, blk)
+                if rp.exact:
+                    # extraction-exact: verify scans only these spans;
+                    # no prelim pass needed (verify IS the prelim)
+                    chosen[rp.rule_index] = spans
+                elif self._prelim(fe, rp, spans):
+                    chosen[rp.rule_index] = None
             if chosen:
                 out[fidx] = chosen
         return out
@@ -246,10 +260,13 @@ class BatchSecretScanner:
             out.setdefault(seg_file[int(si)], set()).add(int(sp))
         return out
 
-    def _prelim(self, fe: _FileEntry, rp, anchor_hits: list,
-                blk: int) -> bool:
-        """Windowed existence check around anchor hit blocks."""
-        rule = self.scanner.rules[rp.rule_index]
+    def _windows(self, fe: _FileEntry, rp, anchor_hits: list,
+                 blk: int) -> list:
+        """Merged byte spans around anchor hit blocks: every possible
+        match of the rule lies entirely inside one span, with ≥8 bytes
+        of slack past any match edge (window = max match len, plus
+        MAX_CODE_LEN for the anchor literal body crossing a block
+        edge)."""
         w = rp.window + MAX_CODE_LEN
         spans = []
         for pos, mask in anchor_hits:
@@ -268,6 +285,13 @@ class BatchSecretScanner:
                 merged[-1] = (merged[-1][0], max(merged[-1][1], b))
             else:
                 merged.append((a, b))
+        return merged
+
+    def _prelim(self, fe: _FileEntry, rp, merged: list) -> bool:
+        """Windowed existence check for rules whose window proof is
+        sound for detection but not extraction (elastic edges, ^/$):
+        a hit here still requires the reference whole-file scan."""
+        rule = self.scanner.rules[rp.rule_index]
         for a, b in merged:
             # decode mirrors Scanner.scan; edge-partial codepoints sit
             # in the ≥8-byte margin outside any possible match span

@@ -33,6 +33,7 @@ class RulePlan:
     anchored: bool = False
     anchors: list = field(default_factory=list)   # code indices
     window: int = 0               # bytes each side of an anchor hit
+    exact: bool = False           # windowed verify is extraction-exact
     run_gate: list = field(default_factory=list)  # run-spec indices
 
 
@@ -73,6 +74,7 @@ def build_scan_plan(rules) -> ScanPlan:
             rp.anchored = True
             rp.anchors = sorted({table.index(a) for a in ra.literals})
             rp.window = ra.window
+            rp.exact = ra.exact
         else:
             # non-anchored: a mandatory long class-run is a sound
             # extra gate before the whole-file host scan
@@ -83,6 +85,15 @@ def build_scan_plan(rules) -> ScanPlan:
                     gates = run_gates(core)
                 except Exception:
                     gates = []
+                # drop dominated gates: (bs1, n1) filters nothing when
+                # a (bs2 ⊆ bs1, n2 ≥ n1) gate exists — any run passing
+                # the narrow gate passes the wide one
+                gates = [
+                    (bs1, n1) for bs1, n1 in gates
+                    if not any(
+                        (bs2, n2) != (bs1, n1) and bs2 <= bs1 and n2 >= n1
+                        for bs2, n2 in gates)
+                ]
                 for bs, runlen in gates:
                     spec = RunSpec.from_byteset(bs, runlen)
                     if spec not in spec_index:

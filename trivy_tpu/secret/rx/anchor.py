@@ -317,6 +317,55 @@ def _best_candidate(node) -> Optional[_Cand]:
 
 MIN_RUN_GATE = 16         # shortest class-run worth a TPU gate
 MAX_RUN_GATE = 64         # cap (also bounds required segment overlap)
+MIN_CHAIN_GATE = 8        # shorter chains allowed when the byteset...
+MAX_CHAIN_SET = 20        # ...stays this narrow (specificity holds)
+
+
+def _chain_unit(node):
+    """(byteset, min_len) for a chain-combinable part, or None.
+
+    A part joins a contiguous-run chain when every byte it can
+    contribute is a known ASCII set: a literal/class, or a bounded or
+    unbounded repeat of one (an unbounded repeat only *adds* bytes from
+    its set — min contribution still node.min). Zero-width parts keep
+    the chain contiguous without contributing."""
+    if isinstance(node, (Boundary, Empty)):
+        return frozenset(), 0
+    if isinstance(node, Lit):
+        return (node.bytes, 1) if node.ascii_only else None
+    if isinstance(node, Rep) and isinstance(node.node, Lit) \
+            and node.node.ascii_only:
+        return node.node.bytes, node.min
+    return None
+
+
+def _chain_gates(parts: list) -> list:
+    """Run gates from chains of consecutive classifiable parts: every
+    match contains the parts' contributions CONTIGUOUSLY, so it
+    contains a run of ≥ Σ min_len bytes drawn from the byteset union
+    (e.g. ``[0-9]{4}-?[0-9]{4}-?[0-9]{4}`` → 12 bytes of [0-9-]).
+    Narrow unions qualify at MIN_CHAIN_GATE; anything at MIN_RUN_GATE."""
+    out = []
+    bs: frozenset = frozenset()
+    total = 0
+
+    def flush():
+        nonlocal bs, total
+        if bs and (total >= MIN_RUN_GATE
+                   or (total >= MIN_CHAIN_GATE
+                       and len(bs) <= MAX_CHAIN_SET)):
+            out.append((bs, min(total, MAX_RUN_GATE)))
+        bs, total = frozenset(), 0
+
+    for p in parts:
+        u = _chain_unit(p)
+        if u is None:
+            flush()
+            continue
+        bs |= u[0]
+        total += u[1]
+    flush()
+    return out
 
 
 def run_gates(node) -> list:
@@ -343,6 +392,7 @@ def run_gates(node) -> list:
             else:
                 out.extend(run_gates(node.node))
     elif isinstance(node, Cat):
+        out.extend(_chain_gates(node.parts))
         for p in node.parts:
             out.extend(run_gates(p))
     elif isinstance(node, Alt):
@@ -365,6 +415,21 @@ class RuleAnchor:
     anchored: bool
     literals: list            # lowercased anchor literals (if anchored)
     window: int               # max match length bound (if anchored)
+    exact: bool = False       # windowed finditer == whole-file finditer
+
+
+def _has_hard_boundary(node) -> bool:
+    """``^``/``$`` make matching position-dependent beyond the match
+    bytes themselves, so windowed extraction cannot be exact."""
+    if isinstance(node, Boundary):
+        return node.kind in ("^", "$")
+    if isinstance(node, Cat):
+        return any(_has_hard_boundary(p) for p in node.parts)
+    if isinstance(node, Alt):
+        return any(_has_hard_boundary(o) for o in node.options)
+    if isinstance(node, Rep):
+        return _has_hard_boundary(node.node)
+    return False
 
 
 def analyze_rule(pattern: str, max_window: int = 2048) -> RuleAnchor:
@@ -372,6 +437,20 @@ def analyze_rule(pattern: str, max_window: int = 2048) -> RuleAnchor:
 
     ``max_window`` caps how large a bounded match we are willing to
     verify through windows — beyond that, whole-file is cheaper.
+
+    ``exact`` upgrade: when no elastic edge was stripped (extra == 0)
+    and the core has no ``^``/``$``, a finditer restricted to the
+    merged anchor windows returns byte-identical matches to a
+    whole-file finditer, so the host never re-scans the whole file.
+    Proof sketch: every match contains an anchor occurrence q and fits
+    in [q-window, q+window]; the kernel reports every occurrence of
+    every anchor, each contributing a window that the batch layer
+    merges with overlapping neighbours — so for any position p where
+    the engine attempts a match inside a region, all bytes any attempt
+    from p can examine (≤ window, quantifiers all bounded) lie inside
+    that same merged region, with ≥8 bytes of slack for ``\\b``
+    look-around at the edges. Region-wise finditer therefore visits
+    the same (position, match) sequence as whole-file finditer.
     """
     try:
         ast, extra = strip_elastic(parse(pattern))
@@ -383,6 +462,7 @@ def analyze_rule(pattern: str, max_window: int = 2048) -> RuleAnchor:
     lits = anchor_literals(ast)
     if not lits:
         return RuleAnchor(False, [], 0)
+    exact = extra == 0 and not _has_hard_boundary(ast)
     # +2 slack keeps the edge-elastic soundness argument (a truncated
     # whitespace run must retain ≥min+1 bytes inside the window).
-    return RuleAnchor(True, lits, int(m) + extra + 2)
+    return RuleAnchor(True, lits, int(m) + extra + 2, exact)
