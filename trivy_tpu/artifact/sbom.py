@@ -11,7 +11,6 @@ no tar walking, no analyzers.
 from __future__ import annotations
 
 import hashlib
-import json
 from typing import Optional
 
 from .. import sbom as sbom_mod
@@ -22,12 +21,20 @@ from .artifact import ArtifactOption
 log = get_logger("artifact.sbom")
 
 
+# Bump when decode semantics change: the cache key must not collide
+# across decoder behaviors (reference keys on blob JSON + analyzer
+# versions, sbom.go:98-111; keying on input bytes + decoder version
+# gives the same rescan-hit property without serializing the blob —
+# the blob-JSON round-trip was 65% of SBOM decode time at 10k scale).
+DECODER_VERSION = b"sbom-decoder-v1"
+
+
 def decode_to_blob(data: bytes):
     """One-pass decode of SBOM bytes into the cacheable unit:
     ``(artifact_type, decoded, blob, blob_id)``. The blob id is the
-    sha256 of the canonical blob JSON, so identical SBOMs dedup in the
-    cache. Shared by SBOMArtifact and BatchScanRunner.scan_boms.
-    Raises ValueError on unknown format."""
+    sha256 of (decoder version, input bytes), so rescans of an
+    unchanged SBOM are cache hits. Shared by SBOMArtifact and
+    BatchScanRunner.scan_boms. Raises ValueError on unknown format."""
     try:
         fmt, decoded = sbom_mod.sniff_and_decode(data)
     except (KeyError, AttributeError, TypeError) as e:
@@ -39,8 +46,9 @@ def decode_to_blob(data: bytes):
         package_infos=decoded.packages,
         applications=decoded.applications,
     )
-    raw = json.dumps(blob.to_dict(), sort_keys=True).encode()
-    blob_id = "sha256:" + hashlib.sha256(raw).hexdigest()
+    h = hashlib.sha256(DECODER_VERSION)
+    h.update(data)
+    blob_id = "sha256:" + h.hexdigest()
     artifact_type = "cyclonedx" if fmt in (
         sbom_mod.FORMAT_CYCLONEDX_JSON,
         sbom_mod.FORMAT_CYCLONEDX_XML,
