@@ -159,3 +159,46 @@ class TestIngestion:
         from trivy_tpu.db import CompiledDB
         cdb = CompiledDB.load(out_prefix)
         assert cdb.stats["rows"] == 4
+
+
+def test_meta_checksum_rejects_torn_meta(tmp_path):
+    """Round 4 (ADVICE): a corrupted meta with a higher txid must lose
+    to the older valid meta via the FNV-64a checksum (bbolt
+    meta.validate), not win by txid."""
+    import struct
+    from trivy_tpu.db.boltdb import MAGIC, PAGE_HEADER, BoltDB, _fnv64a
+    from trivy_tpu.db.boltwriter import write_trivy_db
+    path = str(tmp_path / "t.db")
+    write_trivy_db(path, {"alpine 3.16": {"p": {"CVE-1": {
+        "FixedVersion": "1.0"}}}}, {})
+    with BoltDB(path) as db:
+        good_root = db._root_pgid
+    data = bytearray(open(path, "rb").read())
+    # meta1 (txid 2, the winner): corrupt its root pgid but leave the
+    # stale checksum — the reader must now fall back to meta0
+    base = 4096 + PAGE_HEADER
+    struct.pack_into("<Q", data, base + 16, 0xDEAD)
+    open(path, "wb").write(bytes(data))
+    with BoltDB(path) as db:
+        assert db._root_pgid == good_root
+    # now also give meta0 a BAD checksum -> unreadable file
+    base0 = PAGE_HEADER
+    struct.pack_into("<Q", data, base0 + 56, 12345)
+    open(path, "wb").write(bytes(data))
+    import pytest
+    from trivy_tpu.db.boltdb import CorruptDB
+    with pytest.raises(CorruptDB):
+        BoltDB(path)
+
+
+def test_writer_emits_valid_checksums(tmp_path):
+    import struct
+    from trivy_tpu.db.boltdb import PAGE_HEADER, _fnv64a
+    from trivy_tpu.db.boltwriter import write_trivy_db
+    path = str(tmp_path / "t.db")
+    write_trivy_db(path, {"b": {"p": {"V": {"FixedVersion": "1"}}}}, {})
+    data = open(path, "rb").read()
+    for off in (0, 4096):
+        base = off + PAGE_HEADER
+        want = struct.unpack_from("<Q", data, base + 56)[0]
+        assert want == _fnv64a(data[base:base + 56])

@@ -201,3 +201,74 @@ def test_hot_swap_blocks_until_readers_drain(fixture_cdb):
     t.join(timeout=5)
     assert done.is_set()
     assert sw.current() is new_db
+
+
+def test_save_is_atomic_single_file(fixture_cdb, tmp_path):
+    """Round 4 (ADVICE): persistence is ONE data-only npz written via
+    temp+rename — no pickle sidecar, no partial pair to observe."""
+    import os
+    path = str(tmp_path / "db")
+    fixture_cdb.save(path)
+    assert os.path.exists(path + ".npz")
+    assert not os.path.exists(path + ".pkl")
+    assert not os.path.exists(path + ".npz.tmp")
+    # file must be loadable by a plain JSON/npz reader (data-only):
+    import json as _json
+    import numpy as _np
+    arrs = _np.load(path + ".npz")
+    meta = _json.loads(arrs["meta"].tobytes().decode())
+    assert "rows_meta" in meta and "universe" in meta
+
+
+def test_load_restores_key_types(fixture_cdb, tmp_path):
+    """bisect at scan time compares fresh parse keys against loaded
+    ones — types must round-trip exactly for every grammar."""
+    path = str(tmp_path / "db")
+    fixture_cdb.save(path)
+    loaded = CompiledDB.load(path)
+    for g, (keys, base) in fixture_cdb.universe.items():
+        k2, b2 = loaded.universe[g]
+        assert b2 == base and k2 == keys
+        for a, b in zip(keys, k2):
+            assert type(a) is type(b), (g, type(a), type(b))
+
+
+def test_truncated_db_does_not_kill_watcher(fixture_cdb, tmp_path):
+    """A garbage file at the watched path must log and keep the old
+    tables (ADVICE: the old except clause let zip errors kill the
+    watcher thread permanently)."""
+    from trivy_tpu.db.compiled import SwappableStore
+    from trivy_tpu.rpc.server import DBWorker
+    path = str(tmp_path / "db")
+    fixture_cdb.save(path)
+    store = SwappableStore(fixture_cdb)
+    w = DBWorker(store, path, interval_s=3600)
+    with open(path + ".npz", "wb") as f:
+        f.write(b"PK\x03\x04 definitely not a real zip")
+    assert w.check_once() is False
+    assert store.current() is fixture_cdb      # old tables intact
+    fixture_cdb.save(path)                      # recovery still works
+    assert w.check_once() is True
+
+
+def test_date_only_values_round_trip(tmp_path):
+    """yaml parses unquoted day-only values into datetime.date —
+    save must tag them, load must restore the exact type."""
+    import datetime
+    from trivy_tpu.db import AdvisoryStore
+    s = AdvisoryStore()
+    s.put_advisory("alpine 3.16", "p", "CVE-9",
+                   {"FixedVersion": "1.0.0-r0"})
+    s.put_vulnerability("CVE-9", {
+        "Severity": "LOW",
+        "PublishedDate": datetime.date(2020, 2, 1),
+        "LastModifiedDate": datetime.datetime(
+            2020, 9, 14, 18, 32,
+            tzinfo=datetime.timezone.utc)})
+    cdb = CompiledDB.compile(s)
+    path = str(tmp_path / "db")
+    cdb.save(path)
+    v = CompiledDB.load(path).vulnerabilities["CVE-9"]
+    assert v["PublishedDate"] == datetime.date(2020, 2, 1)
+    assert type(v["PublishedDate"]) is datetime.date
+    assert v["LastModifiedDate"].tzinfo is not None

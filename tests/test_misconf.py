@@ -518,3 +518,24 @@ class TestExtendedDockerfilePolicies:
         assert "DS025" not in self._fails(
             b"FROM alpine:3.16\nRUN apk add --no-cache curl\n"
             b"USER app\nHEALTHCHECK CMD true\n")
+
+
+class TestFlagTokenizing:
+    def test_quoted_flag_value_with_space(self):
+        """ADVICE round 4: a quoted flag value containing spaces must
+        not leak into the instruction value."""
+        from trivy_tpu.misconf.dockerfile import parse
+        stages = parse(
+            b'FROM alpine:3.16\n'
+            b'RUN --mount=type=secret,id="my id" make install\n')
+        inst = stages[0].instructions[0]
+        assert inst.flags == ['--mount=type=secret,id="my id"']
+        assert inst.value == "make install"
+
+    def test_single_quoted_flag(self):
+        from trivy_tpu.misconf.dockerfile import parse
+        stages = parse(
+            b"FROM a\nRUN --mount=from='a b' true\n")
+        inst = stages[0].instructions[0]
+        assert inst.flags == ["--mount=from='a b'"]
+        assert inst.value == "true"

@@ -67,7 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--ignore-policy", default="",
                         help="Python policy file defining "
                         "ignore(finding) (the Rego ignore-policy "
-                        "analog)")
+                        "analog). WARNING: executed with full "
+                        "interpreter rights, unlike the reference's "
+                        "sandboxed Rego — only point it at files "
+                        "you trust")
         sp.add_argument("--exit-code", type=int, default=0)
         sp.add_argument("--skip-dirs", default="")
         sp.add_argument("--skip-files", default="")
@@ -172,7 +175,7 @@ def build_parser() -> argparse.ArgumentParser:
                        help="trivy-db BoltDB file (the reference's "
                        "native advisory store format)")
     build.add_argument("--output", "-o", required=True,
-                       help="output path prefix (.npz/.pkl)")
+                       help="output path prefix (.npz)")
 
     srv = sub.add_parser("server", help="run in server mode "
                          "(owns cache + advisory DB + TPU dispatch)")
@@ -503,7 +506,7 @@ def run_db(args) -> int:
     cdb.save(args.output)
     print(f"compiled {cdb.stats['rows']} advisories "
           f"({cdb.stats['host_fallback_rows']} host-fallback, "
-          f"{compile_s:.2f}s) -> {args.output}.npz/.pkl")
+          f"{compile_s:.2f}s) -> {args.output}.npz")
     return 0
 
 

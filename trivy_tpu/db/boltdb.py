@@ -43,6 +43,14 @@ class CorruptDB(ValueError):
     pass
 
 
+def _fnv64a(data: bytes) -> int:
+    """FNV-64a (bbolt meta.sum64) — validates meta checksums."""
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 def _unpack(fmt: str, buf, off: int) -> tuple:
     try:
         return struct.unpack_from(fmt, buf, off)
@@ -144,6 +152,30 @@ class BoltDB:
 
     # -- low level --
 
+    def _meta_at(self, off: int):
+        """Decode + validate one meta page; None if invalid.
+
+        Field layout mirrors bbolt's meta struct (magic, version,
+        pageSize, flags, root bucket{pgid, seq}, freelist, pgid,
+        txid, checksum — txid at +48). A nonzero checksum must equal
+        FNV-64a over the first 56 meta bytes (bbolt meta.validate);
+        on a torn write the corrupt meta is skipped so the older
+        valid meta wins instead of a garbage tree."""
+        if off + PAGE_HEADER + 64 > len(self._mm):
+            return None
+        base = off + PAGE_HEADER
+        magic, version, page_size = struct.unpack_from(
+            "<III", self._mm, base)
+        if magic != MAGIC or version != 2:
+            return None
+        root_pgid = struct.unpack_from("<Q", self._mm, base + 16)[0]
+        txid, checksum = struct.unpack_from(
+            "<QQ", self._mm, base + 48)
+        if checksum and checksum != _fnv64a(
+                self._mm[base:base + 56]):
+            return None
+        return (page_size, root_pgid, txid)
+
     def _read_meta(self) -> tuple:
         # try both meta pages (0 and 1), prefer the valid one with
         # the highest txid (bbolt picks the newer valid meta)
@@ -151,34 +183,19 @@ class BoltDB:
         # meta1 sits at page_size; probe the common page sizes so a
         # torn meta0 on a 16K-page host is still recoverable
         for off in (0, 4096, 8192, 16384, 32768, 65536):
-            if off + PAGE_HEADER + 64 > len(self._mm):
+            m = self._meta_at(off)
+            if m is None:
                 continue
-            base = off + PAGE_HEADER
-            magic, version, page_size = struct.unpack_from(
-                "<III", self._mm, base)
-            if magic != MAGIC or version != 2:
-                continue
-            if off not in (0, page_size):
+            if off not in (0, m[0]):
                 continue   # not a real meta page for this db
-            root_pgid, _seq = struct.unpack_from(
-                "<QQ", self._mm, base + 16)
-            txid = struct.unpack_from("<Q", self._mm, base + 40)[0]
-            if best is None or txid > best[2]:
-                best = (page_size, root_pgid, txid)
+            if best is None or m[2] > best[2]:
+                best = m
             # meta1 actually lives at page_size, not 4096 — re-probe
             # when the first meta reports a different page size
-            if off == 0 and page_size != 4096:
-                base2 = page_size + PAGE_HEADER
-                if base2 + 64 <= len(self._mm):
-                    m2, v2, ps2 = struct.unpack_from(
-                        "<III", self._mm, base2)
-                    if m2 == MAGIC and v2 == 2:
-                        r2, _ = struct.unpack_from(
-                            "<QQ", self._mm, base2 + 16)
-                        t2 = struct.unpack_from(
-                            "<Q", self._mm, base2 + 40)[0]
-                        if t2 > best[2]:
-                            best = (ps2, r2, t2)
+            if off == 0 and m[0] != 4096:
+                m2 = self._meta_at(m[0])
+                if m2 is not None and m2[2] > best[2]:
+                    best = m2
         if best is None:
             raise CorruptDB(f"not a boltdb file: {self.path}")
         return best[0], best[1]

@@ -102,14 +102,15 @@ class Writer:
 
         def meta(pgid, txid) -> bytes:
             m = _page_header(pgid, FLAG_META, 0)
-            m += struct.pack("<III", MAGIC, 2, PAGE_SIZE)
-            m += struct.pack("<I", 0)                  # meta flags
-            m += struct.pack("<QQ", root_pgid, 0)      # root bucket
-            m += struct.pack("<Q", 2)                  # freelist
-            m += struct.pack("<Q", high)               # pgid high water
-            m += struct.pack("<Q", txid)
-            m += struct.pack("<Q", 0)                  # checksum: 0
-            return m.ljust(PAGE_SIZE, b"\x00")
+            body = struct.pack("<III", MAGIC, 2, PAGE_SIZE)
+            body += struct.pack("<I", 0)               # meta flags
+            body += struct.pack("<QQ", root_pgid, 0)   # root bucket
+            body += struct.pack("<Q", 2)               # freelist
+            body += struct.pack("<Q", high)            # pgid high water
+            body += struct.pack("<Q", txid)
+            from .boltdb import _fnv64a                # bbolt sum64
+            body += struct.pack("<Q", _fnv64a(body))
+            return (m + body).ljust(PAGE_SIZE, b"\x00")
 
         out[0:PAGE_SIZE] = meta(0, 1)
         out[PAGE_SIZE:2 * PAGE_SIZE] = meta(1, 2)

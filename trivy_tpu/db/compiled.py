@@ -23,16 +23,22 @@ dispatch (ops.intervals.interval_hits_resident) evaluates every
 reused across scans; ``SwappableStore`` double-buffers them for hot
 swaps (reference: pkg/rpc/server/listen.go:71-80).
 
-Persistence: ``save``/``load`` round-trip the arrays (npz) plus the
-indexes/universes (pickle) so a compiled DB loads without re-parsing
-a single constraint.
+Persistence: ``save``/``load`` round-trip the arrays plus the
+indexes/universes as ONE npz file whose ``meta`` member is tagged
+JSON — a data-only format (no pickle: a compiled DB may arrive over
+the network in the reference's trivy-db workflow, and the server
+hot-swaps whatever appears at the watched path, so deserialization
+must not be code execution), written to a temp name and atomically
+renamed so the hot-swap watcher can never observe a half-written
+pair.
 """
 
 from __future__ import annotations
 
 import contextlib
 
-import pickle
+import json
+import os
 import threading
 from bisect import bisect_left
 from dataclasses import dataclass, field
@@ -42,7 +48,12 @@ import numpy as np
 
 from ..ops.intervals import MAX_INTERVALS, NEG_INF, POS_INF
 from ..utils import get_logger
+import datetime as _dt
+
 from ..vercmp import get_comparer
+from ..vercmp.maven import _PaddedKey
+from ..vercmp.rubygems import _GemKey
+from ..vercmp.semver import SemverKey
 from .store import Advisory, AdvisoryStore
 
 log = get_logger("db.compiled")
@@ -392,21 +403,35 @@ class CompiledDB:
         return VulnerabilityDetail.from_dict(vuln_id, v)
 
     # ---- persistence ----
+    # (tagged-JSON helpers for save/load live at module scope below)
 
     def save(self, path: str) -> None:
-        np.savez_compressed(
-            path + ".npz", v_lo=self.v_lo, v_hi=self.v_hi,
-            s_lo=self.s_lo, s_hi=self.s_hi, flags=self.flags)
-        with open(path + ".pkl", "wb") as f:
-            pickle.dump({
-                "rows_meta": self.rows_meta,
-                "row_grammar": self.row_grammar,
-                "index": self.index,
-                "universe": self.universe,
-                "vulnerabilities": self.vulnerabilities,
-                "data_sources": self.data_sources,
-                "stats": self.stats,
-            }, f, protocol=pickle.HIGHEST_PROTOCOL)
+        """Write ``path + ".npz"`` atomically (temp file + rename).
+
+        Everything non-array rides in the ``meta`` member as tagged
+        JSON (see ``_enc_key``); a single file means the DBWorker's
+        mtime check can never pair new arrays with stale metadata."""
+        meta = {
+            "rows_meta": [(b, p, _adv_enc(a))
+                          for b, p, a in self.rows_meta],
+            "row_grammar": self.row_grammar,
+            "index": self.index,
+            "universe": {g: [[_enc_key(k) for k in keys], base]
+                         for g, (keys, base) in self.universe.items()},
+            "vulnerabilities": self.vulnerabilities,
+            "data_sources": self.data_sources,
+            "stats": self.stats,
+        }
+        blob = np.frombuffer(
+            json.dumps(meta, default=_json_default).encode(),
+            np.uint8)
+        tmp = path + ".npz.tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f, v_lo=self.v_lo, v_hi=self.v_hi,
+                s_lo=self.s_lo, s_hi=self.s_hi, flags=self.flags,
+                meta=blob)
+        os.replace(tmp, path + ".npz")
 
     @classmethod
     def load(cls, path: str) -> "CompiledDB":
@@ -415,16 +440,103 @@ class CompiledDB:
         self.v_lo, self.v_hi = arrs["v_lo"], arrs["v_hi"]
         self.s_lo, self.s_hi = arrs["s_lo"], arrs["s_hi"]
         self.flags = arrs["flags"]
-        with open(path + ".pkl", "rb") as f:
-            d = pickle.load(f)
-        self.rows_meta = d["rows_meta"]
+        if "meta" not in arrs:
+            raise ValueError(
+                f"{path}.npz has no meta member — rebuild with "
+                f"'db build' (pre-data-only-format file?)")
+        d = json.loads(arrs["meta"].tobytes().decode(),
+                       object_hook=_json_hook)
+        self.rows_meta = [(b, p, _adv_dec(a))
+                          for b, p, a in d["rows_meta"]]
         self.row_grammar = d["row_grammar"]
         self.index = d["index"]
-        self.universe = d["universe"]
+        self.universe = {g: ([_dec_key(k) for k in keys], base)
+                         for g, (keys, base) in d["universe"].items()}
         self.vulnerabilities = d["vulnerabilities"]
         self.data_sources = d["data_sources"]
         self.stats = d["stats"]
         return self
+
+
+# ---- data-only persistence helpers ---------------------------------
+#
+# Version-grammar parse keys are nested tuples, sometimes wrapped in a
+# grammar's own comparable class (SemverKey, maven _PaddedKey,
+# rubygems _GemKey). bisect at scan time compares freshly parsed keys
+# against persisted ones, so the round-trip must restore EXACT types —
+# hence a tagged encoding over a closed class set that fails loudly on
+# anything new instead of silently pickling it.
+
+def _enc_key(v):
+    if isinstance(v, SemverKey):
+        return ["sv"] + [_enc_key(x) for x in v]
+    if isinstance(v, _PaddedKey):
+        return ["mv", _enc_key(v.toks)]
+    if isinstance(v, _GemKey):
+        return ["gem", _enc_key(v.segs)]
+    if isinstance(v, tuple):
+        return ["t"] + [_enc_key(x) for x in v]
+    if isinstance(v, list):
+        return ["l"] + [_enc_key(x) for x in v]
+    if v is None or isinstance(v, (int, float, str, bool)):
+        return v
+    raise TypeError(f"unencodable universe key part: {type(v)}")
+
+
+def _dec_key(v):
+    if not isinstance(v, list):
+        return v
+    tag, rest = v[0], v[1:]
+    if tag == "sv":
+        return SemverKey(tuple(_dec_key(x) for x in rest))
+    if tag == "mv":
+        return _PaddedKey(_dec_key(rest[0]))
+    if tag == "gem":
+        return _GemKey(_dec_key(rest[0]))
+    if tag == "t":
+        return tuple(_dec_key(x) for x in rest)
+    if tag == "l":
+        return [_dec_key(x) for x in rest]
+    raise ValueError(f"bad universe key tag: {tag!r}")
+
+
+def _json_default(o):
+    """Vulnerability detail dicts come from YAML fixtures, which parse
+    ISO timestamps into datetime (and unquoted day-only values into
+    date) — tag both for the round-trip."""
+    if isinstance(o, _dt.datetime):
+        return {"$dt": o.isoformat()}
+    if isinstance(o, _dt.date):
+        return {"$d": o.isoformat()}
+    raise TypeError(f"unencodable compiled-db value: {type(o)}")
+
+
+def _json_hook(d: dict):
+    if len(d) == 1:
+        if "$dt" in d:
+            return _dt.datetime.fromisoformat(d["$dt"])
+        if "$d" in d:
+            return _dt.date.fromisoformat(d["$d"])
+    return d
+
+
+def _adv_enc(a: Advisory) -> list:
+    ds = a.data_source
+    return [a.vulnerability_id, a.fixed_version, a.affected_version,
+            a.vulnerable_versions, a.patched_versions,
+            a.unaffected_versions, a.arches, a.severity, a.vendor_ids,
+            [ds.id, ds.name, ds.url] if ds is not None else None]
+
+
+def _adv_dec(v: list) -> Advisory:
+    from ..types import DataSource
+    ds = DataSource(id=v[9][0], name=v[9][1], url=v[9][2]) \
+        if v[9] is not None else None
+    return Advisory(
+        vulnerability_id=v[0], fixed_version=v[1],
+        affected_version=v[2], vulnerable_versions=v[3],
+        patched_versions=v[4], unaffected_versions=v[5],
+        arches=v[6], severity=v[7], vendor_ids=v[8], data_source=ds)
 
 
 class SwappableStore:

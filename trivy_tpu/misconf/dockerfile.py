@@ -29,6 +29,25 @@ class Stage:
     start_line: int = 0
 
 
+def _take_token(s: str) -> tuple:
+    """Split off the leading token, treating quoted spans as atomic —
+    a flag value like ``--mount=type=secret,id="my id"`` must not
+    leak its tail into the instruction value (buildkit's shell-word
+    flag lexing)."""
+    j, q = 0, ""
+    while j < len(s):
+        ch = s[j]
+        if q:
+            if ch == q:
+                q = ""
+        elif ch in "\"'":
+            q = ch
+        elif ch.isspace():
+            break
+        j += 1
+    return s[:j], s[j:].strip()
+
+
 def parse(content: bytes) -> list:
     """→ list[Stage]; a file with no FROM yields one anonymous
     stage so instruction-level checks still run."""
@@ -59,9 +78,8 @@ def parse(content: bytes) -> list:
         rest = parts[1] if len(parts) > 1 else ""
         flags = []
         while rest.startswith("--"):
-            flag, _, rest = rest.partition(" ")
+            flag, rest = _take_token(rest)
             flags.append(flag)
-            rest = rest.strip()
         inst = Instruction(cmd=cmd, value=rest, start_line=start,
                            end_line=end, flags=flags)
 

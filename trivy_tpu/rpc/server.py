@@ -145,7 +145,11 @@ class DBWorker(threading.Thread):
         if mtime and mtime != self._mtime:
             try:
                 cdb = CompiledDB.load(self.db_prefix)
-            except (OSError, ValueError) as e:
+            except Exception as e:
+                # any load failure (truncated zip, bad JSON, OSError)
+                # must leave the watcher alive with the old tables —
+                # CompiledDB.save renames atomically, but the watched
+                # path can still receive garbage from outside
                 log.warning("db reload failed: %s", e)
                 return False
             self._mtime = mtime

@@ -23,7 +23,12 @@ def load_ignore_policy(path: str):
     """--ignore-policy: a Python file defining ``ignore(finding) ->
     bool`` over the finding's JSON dict (the analog of the
     reference's Rego ``data.trivy.ignore`` query, filter.go:162-219;
-    Python predicate instead of OPA — same contract, same hook)."""
+    Python predicate instead of OPA — same contract, same hook).
+
+    TRUST DIFFERENCE vs the reference: Rego is evaluated in a
+    sandbox; this policy file is ``exec``ed with full interpreter
+    rights (as is a module loaded by module/__init__.py). Treat
+    policy files like code you run, not like configuration."""
     if not path:
         return None
     import types as _types

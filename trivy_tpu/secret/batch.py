@@ -132,11 +132,16 @@ class BatchSecretScanner:
             idxs = sorted(chosen)
             rules = [self.scanner.rules[i] for i in idxs]
             regions = [chosen[i] for i in idxs]
-            windowed += sum(1 for r in regions if r is not None)
-            wholefile += sum(1 for r in regions if r is None)
             sub = Scanner(rules, self.scanner.allow_rules,
                           self.scanner.exclude_block)
             secret = sub.scan(fe.path, fe.content, regions=regions)
+            # count AFTER the scan: multibyte files silently fall
+            # back whole-file inside Scanner.scan
+            if getattr(sub, "used_regions", False):
+                windowed += sum(1 for r in regions if r is not None)
+                wholefile += sum(1 for r in regions if r is None)
+            else:
+                wholefile += len(regions)
             if secret.findings:
                 results.append((fe.index, secret))
         verify_s = _time.perf_counter() - t0

@@ -122,6 +122,7 @@ class Scanner:
         anchor hits, valid only when the rule's window proof is
         extraction-exact. Byte spans equal char spans only for 1:1
         decodes, so any multibyte file falls back whole-file."""
+        self.used_regions = False
         if self.allow_path(file_path):
             return Secret(file_path=file_path)
 
@@ -131,6 +132,7 @@ class Scanner:
         to_bytes = _offset_converter(text, content)
         if regions is not None and len(text) != len(content):
             regions = None
+        self.used_regions = regions is not None
         lowered = content.lower()
         global_blocks = _Blocks(content, self.exclude_block.regexes)
 
