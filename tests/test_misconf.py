@@ -539,3 +539,397 @@ class TestFlagTokenizing:
         inst = stages[0].instructions[0]
         assert inst.flags == ["--mount=from='a b'"]
         assert inst.value == "true"
+
+
+# ---------------------------------------------------------------- round 4
+
+
+TF_BAD = b'''
+variable "cidr" { default = "0.0.0.0/0" }
+
+resource "aws_s3_bucket" "logs" {
+  bucket = "logs"
+  acl    = "public-read"
+}
+
+resource "aws_security_group" "web" {
+  ingress {
+    from_port   = 443
+    to_port     = 443
+    cidr_blocks = [var.cidr]
+  }
+}
+
+resource "aws_db_instance" "db" {
+  storage_encrypted = false
+}
+'''
+
+TF_GOOD = b'''
+resource "aws_s3_bucket" "logs" {
+  bucket = "logs"
+  server_side_encryption_configuration {
+    rule { }
+  }
+  versioning { enabled = true }
+  logging { target_bucket = "lb" }
+}
+
+resource "aws_s3_bucket_public_access_block" "pab" {
+  bucket                  = aws_s3_bucket.logs.id
+  block_public_acls       = true
+  block_public_policy     = true
+  ignore_public_acls      = true
+  restrict_public_buckets = true
+}
+
+resource "aws_security_group" "web" {
+  description = "internal"
+  ingress {
+    from_port   = 443
+    to_port     = 443
+    cidr_blocks = ["10.0.0.0/8"]
+  }
+}
+
+resource "aws_db_instance" "db" {
+  storage_encrypted = true
+}
+
+resource "aws_instance" "i" {
+  metadata_options { http_tokens = "required" }
+  root_block_device { encrypted = true }
+}
+
+resource "aws_ebs_volume" "v" { encrypted = true }
+'''
+
+
+class TestTerraformScan:
+    def _scan(self, content, path="main.tf"):
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        return scan_config_files(
+            [ConfigFile(type="terraform", file_path=path,
+                        content=content)])
+
+    def test_bad_module_fails(self):
+        out = self._scan(TF_BAD)
+        assert len(out) == 1 and out[0].file_type == "terraform"
+        fails = {f.avd_id for f in out[0].failures}
+        assert {"AVD-AWS-0092", "AVD-AWS-0107", "AVD-AWS-0080",
+                "AVD-AWS-0088", "AVD-AWS-0094"} <= fails
+        sg = [f for f in out[0].failures
+              if f.avd_id == "AVD-AWS-0107"][0]
+        assert sg.cause_metadata.resource == \
+            "aws_security_group.web"
+        assert sg.cause_metadata.start_line > 0
+        assert sg.type == "Terraform Security Check"
+        assert sg.namespace.startswith("builtin.terraform.")
+
+    def test_good_module_passes(self):
+        out = self._scan(TF_GOOD)
+        fails = {f.avd_id for f in out[0].failures}
+        assert fails == set(), fails
+        assert {s.avd_id for s in out[0].successes} >= {
+            "AVD-AWS-0086", "AVD-AWS-0107", "AVD-AWS-0028"}
+
+    def test_unresolved_never_fails(self):
+        out = self._scan(
+            b'resource "aws_db_instance" "d" {\n'
+            b'  storage_encrypted = var.encrypted\n}\n')
+        assert "AVD-AWS-0080" not in \
+            {f.avd_id for f in out[0].failures}
+
+    def test_cross_file_module(self, ):
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        out = scan_config_files([
+            ConfigFile(type="terraform", file_path="m/vars.tf",
+                       content=b'variable "acl" '
+                               b'{ default = "public-read" }\n'),
+            ConfigFile(type="terraform", file_path="m/s3.tf",
+                       content=b'resource "aws_s3_bucket" "b" '
+                               b'{ acl = var.acl }\n'),
+        ])
+        by_path = {m.file_path: m for m in out}
+        assert "AVD-AWS-0092" in \
+            {f.avd_id for f in by_path["m/s3.tf"].failures}
+
+
+CFN_BAD = b'''{
+  "AWSTemplateFormatVersion": "2010-09-09",
+  "Resources": {
+    "Bucket": {"Type": "AWS::S3::Bucket",
+               "Properties": {"AccessControl": "PublicRead"}},
+    "SG": {"Type": "AWS::EC2::SecurityGroup",
+           "Properties": {"SecurityGroupIngress": [
+               {"IpProtocol": "tcp", "CidrIp": "0.0.0.0/0"}]}}
+  }
+}'''
+
+CFN_YAML_INTRINSICS = b'''
+AWSTemplateFormatVersion: "2010-09-09"
+Resources:
+  Vol:
+    Type: AWS::EC2::Volume
+    Properties:
+      Encrypted: !Ref EncryptMe
+      Size: 10
+  DB:
+    Type: AWS::RDS::DBInstance
+    Properties:
+      StorageEncrypted: true
+      DBName: !Sub "${AWS::StackName}-db"
+'''
+
+
+class TestCloudFormationScan:
+    def _scan(self, content, ftype="json", path="t.json"):
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        return scan_config_files(
+            [ConfigFile(type=ftype, file_path=path, content=content)])
+
+    def test_json_template(self):
+        out = self._scan(CFN_BAD)
+        assert out and out[0].file_type == "cloudformation"
+        fails = {f.avd_id for f in out[0].failures}
+        assert {"AVD-AWS-0092", "AVD-AWS-0107"} <= fails
+        assert out[0].failures[0].type == \
+            "CloudFormation Security Check"
+
+    def test_yaml_intrinsics_never_fail(self):
+        out = self._scan(CFN_YAML_INTRINSICS, ftype="yaml",
+                         path="t.yaml")
+        assert out and out[0].file_type == "cloudformation"
+        fails = {f.avd_id for f in out[0].failures}
+        # Encrypted: !Ref is unresolvable -> no provable FAIL
+        assert "AVD-AWS-0026" not in fails
+        assert "AVD-AWS-0080" not in fails
+
+    def test_plain_k8s_yaml_still_kubernetes(self):
+        out = self._scan(
+            b"apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\n"
+            b"spec:\n  containers:\n  - name: c\n    image: i\n",
+            ftype="yaml", path="pod.yaml")
+        assert out and out[0].file_type == "kubernetes"
+
+
+CHART_YAML = b"apiVersion: v2\nname: web\nversion: 1.0.0\n"
+VALUES_YAML = b"runAsRoot: true\nimage:\n  tag: latest\n"
+DEPLOY_TPL = b'''apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {{ .Release.Name }}-web
+spec:
+  template:
+    spec:
+      containers:
+      - name: web
+        image: "nginx:{{ .Values.image.tag | default "1.25" }}"
+        securityContext:
+          runAsNonRoot: {{ if .Values.runAsRoot }}false{{ else }}true{{ end }}
+'''
+
+
+class TestHelmScan:
+    def _scan(self, extra_values=None):
+        from trivy_tpu import misconf
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        cfs = [
+            ConfigFile(type="yaml", file_path="chart/Chart.yaml",
+                       content=CHART_YAML),
+            ConfigFile(type="yaml", file_path="chart/values.yaml",
+                       content=VALUES_YAML),
+            ConfigFile(type="yaml",
+                       file_path="chart/templates/deploy.yaml",
+                       content=DEPLOY_TPL),
+        ]
+        return scan_config_files(cfs)
+
+    def test_chart_rendered_and_scanned(self):
+        out = self._scan()
+        helm = [m for m in out if m.file_type == "helm"]
+        assert helm, [m.file_path for m in out]
+        m = helm[0]
+        assert m.file_path == "chart/templates/deploy.yaml"
+        # values.yaml sets runAsRoot -> rendered runAsNonRoot: false
+        fails = {f.id for f in m.failures}
+        assert "KSV012" in fails, fails
+        # chart's own files are not double-reported as yaml/k8s
+        assert not any(m2.file_path == "chart/values.yaml"
+                       for m2 in out)
+
+    def test_helm_values_override(self, tmp_path):
+        from trivy_tpu import misconf
+        vf = tmp_path / "over.yaml"
+        vf.write_text("runAsRoot: false\n")
+        misconf.configure(helm_value_files=[str(vf)])
+        try:
+            out = self._scan()
+            helm = [m for m in out if m.file_type == "helm"]
+            assert "KSV012" not in {f.id for f in helm[0].failures}
+        finally:
+            misconf.configure()
+
+
+CUSTOM_POLICY = '''
+from trivy_tpu.misconf.policies import Cause, Policy
+
+def _no_latest(doc):
+    causes = []
+    for c in (doc.get("spec", {}).get("template", {})
+              .get("spec", {}).get("containers", [])) or []:
+        img = c.get("image", "")
+        if isinstance(img, str) and img.endswith(":latest"):
+            causes.append(Cause(message=f"image {img} uses latest"))
+    return causes
+
+POLICIES = [Policy(
+    id="USR-0001", avd_id="USR-0001",
+    title="No :latest images", description="d", severity="MEDIUM",
+    recommended_actions="pin", references=[],
+    provider="Generic", service="general",
+    check=_no_latest, file_types=("kubernetes",))]
+'''
+
+
+class TestCustomPolicies:
+    def test_config_policy_dir(self, tmp_path):
+        from trivy_tpu import misconf
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        d = tmp_path / "policies"
+        d.mkdir()
+        (d / "latest.py").write_text(CUSTOM_POLICY)
+        misconf.configure(policy_dirs=[str(d)])
+        try:
+            out = scan_config_files([ConfigFile(
+                type="yaml", file_path="d.yaml",
+                content=b"apiVersion: apps/v1\nkind: Deployment\n"
+                        b"metadata:\n  name: d\nspec:\n  template:\n"
+                        b"    spec:\n      containers:\n"
+                        b"      - name: c\n        image: web:latest\n")])
+            fails = [f for f in out[0].failures if f.id == "USR-0001"]
+            assert fails and fails[0].namespace == \
+                "user.kubernetes.USR-0001"
+        finally:
+            misconf.configure()
+
+    def test_bad_policy_dir_raises(self, tmp_path):
+        import pytest
+        from trivy_tpu import misconf
+        d = tmp_path / "p"
+        d.mkdir()
+        (d / "x.py").write_text("syntax error(((")
+        with pytest.raises(ValueError):
+            misconf.configure(policy_dirs=[str(d)])
+        misconf.configure()
+
+
+class TestHCLParser:
+    def _parse(self, src, ctx=None):
+        from trivy_tpu.misconf.hcl import parse_file
+        return parse_file(src, ctx)
+
+    def test_comments_and_heredoc(self):
+        blocks = self._parse(
+            '# c1\n// c2\n/* multi\nline */\n'
+            'resource "t" "n" {\n'
+            '  policy = <<EOF\n{"Statement": []}\nEOF\n'
+            '  after = 1\n}\n')
+        b = blocks[0]
+        assert '"Statement"' in b.attr("policy")
+        assert b.attr("after") == 1
+
+    def test_interpolation_partial(self):
+        from trivy_tpu.misconf.hcl import parse_file
+        b = parse_file('resource "t" "n" { x = "${var.a}-${data.b.c}" }',
+                       {"var": {"a": "v"}, "local": {}})[0]
+        assert b.attr("x") == "v-${data.b.c}"
+
+    def test_operator_expression_unresolved(self):
+        from trivy_tpu.misconf.hcl import Unresolved
+        b = self._parse('resource "t" "n" { x = 1 + 2 }')[0]
+        assert isinstance(b.attr("x"), Unresolved)
+
+    def test_index_expression_unresolved(self):
+        from trivy_tpu.misconf.hcl import Unresolved
+        b = self._parse(
+            'resource "t" "n" {\n  x = var.list[0]\n  y = 2\n}')[0]
+        assert isinstance(b.attr("x"), Unresolved)
+        assert b.attr("y") == 2
+
+    def test_nested_blocks_and_lines(self):
+        b = self._parse(
+            'resource "a" "b" {\n'
+            '  dynamic "ingress" {\n'
+            '    content { from_port = 1 }\n'
+            '  }\n'
+            '}\n')[0]
+        dyn = b.first_block("dynamic")
+        assert dyn is not None and dyn.labels == ["ingress"]
+        assert b.start_line == 1 and b.end_line == 5
+
+
+class TestReviewFixes:
+    """Regression tests for the round-4 misconf review findings."""
+
+    def test_var_without_default_never_fails(self):
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        out = scan_config_files([ConfigFile(
+            type="terraform", file_path="m.tf",
+            content=b'variable "enc" { type = bool }\n'
+                    b'resource "aws_db_instance" "d" '
+                    b'{ storage_encrypted = var.enc }\n')])
+        assert "AVD-AWS-0080" not in \
+            {f.avd_id for f in out[0].failures}
+
+    def test_comparison_expression_unresolved(self):
+        from trivy_tpu.misconf.hcl import Unresolved, parse_file
+        b = parse_file(
+            'resource "t" "n" { x = var.enc == "on"\n  y = true }',
+            {"var": {"enc": "on"}, "local": {}})[0]
+        assert isinstance(b.attr("x"), Unresolved)
+
+    def test_helm_else_if_chain(self):
+        from trivy_tpu.misconf.helm import render
+        tpl = ("{{ if .Values.a }}A{{ else if .Values.b }}B"
+               "{{ else }}C{{ end }}")
+        assert render(tpl, {"a": True, "b": True}) == "A"
+        assert render(tpl, {"a": False, "b": True}) == "B"
+        assert render(tpl, {"a": False, "b": False}) == "C"
+
+    def test_cfn_container_intrinsics_never_fail(self):
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        out = scan_config_files([ConfigFile(
+            type="yaml", file_path="t.yaml", content=b'''
+AWSTemplateFormatVersion: "2010-09-09"
+Resources:
+  B:
+    Type: AWS::S3::Bucket
+    Properties:
+      VersioningConfiguration: !If [C, {Status: Enabled}, !Ref N]
+      PublicAccessBlockConfiguration: !If [C, {}, !Ref N]
+      BucketEncryption: !If [C, {}, !Ref N]
+  SG:
+    Type: AWS::EC2::SecurityGroup
+    Properties:
+      GroupDescription: !Sub "${AWS::StackName}"
+''')])
+        fails = {f.avd_id for f in out[0].failures}
+        assert not {"AVD-AWS-0090", "AVD-AWS-0094", "AVD-AWS-0088",
+                    "AVD-AWS-0099"} & fails, fails
+
+    def test_cause_resource_round_trips_rpc(self):
+        from trivy_tpu.types.convert import cause_metadata_from_dict
+        from trivy_tpu.types.report import CauseMetadata
+        cm = CauseMetadata(resource="aws_security_group.web",
+                           provider="AWS", service="ec2",
+                           start_line=3, end_line=5)
+        back = cause_metadata_from_dict(cm.to_dict())
+        assert back.resource == "aws_security_group.web"

@@ -21,7 +21,7 @@ from .analyzer import AnalysisResult, Analyzer, register_analyzer
 # blobs are data, not config
 MAX_CONFIG_SIZE = 1 << 20
 
-CONFIG_ANALYZER_TYPES = ("dockerfile", "yaml", "json")
+CONFIG_ANALYZER_TYPES = ("dockerfile", "yaml", "json", "terraform")
 
 
 class _Collector(Analyzer):
@@ -66,4 +66,18 @@ class JsonConfigAnalyzer(_Collector):
         if size is not None and size > MAX_CONFIG_SIZE:
             return False
         return path.endswith(".json")
+
+
+@register_analyzer
+class TerraformConfigAnalyzer(_Collector):
+    """Collector for .tf modules (reference:
+    pkg/fanal/analyzer/config/terraform; .tf.json is covered by the
+    JSON collector's CFN/k8s sniffing)."""
+
+    type = "terraform"
+
+    def required(self, path: str, size: Optional[int] = None) -> bool:
+        if size is not None and size > MAX_CONFIG_SIZE:
+            return False
+        return path.endswith(".tf")
 

@@ -86,6 +86,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="load a compiled advisory DB "
                         "(path prefix from 'trivy-tpu db build')")
         sp.add_argument("--secret-config", default="trivy-secret.yaml")
+        sp.add_argument("--config-policy", default="",
+                        help="comma-separated directories of custom "
+                        "misconfig policy modules (Python files "
+                        "defining POLICIES; the reference's custom-"
+                        "rego analog). WARNING: executed with full "
+                        "interpreter rights")
+        sp.add_argument("--helm-values", default="",
+                        help="comma-separated helm values files "
+                        "overriding chart values.yaml")
+        sp.add_argument("--helm-set", default="",
+                        help="comma-separated helm key=value "
+                        "overrides (--set analog)")
         sp.add_argument("--no-cache", action="store_true")
         sp.add_argument("--cache-backend", default="fs",
                         help="layer cache backend: fs | "
@@ -534,6 +546,18 @@ def _artifact_option(args) -> ArtifactOption:
     from .secret.scanner import new_scanner
 
     checks = args.security_checks.split(",")
+    if "config" in checks:
+        from .misconf import configure
+        configure(
+            policy_dirs=[d for d in
+                         getattr(args, "config_policy",
+                                 "").split(",") if d],
+            helm_value_files=[f for f in
+                              getattr(args, "helm_values",
+                                      "").split(",") if f],
+            helm_set_values=[v for v in
+                             getattr(args, "helm_set",
+                                     "").split(",") if v])
     scanner = None
     if "secret" in checks:
         cpu = new_scanner(load_config(args.secret_config))

@@ -22,6 +22,7 @@ class Cause:
     start_line: int = 0
     end_line: int = 0
     resource: str = ""
+    file_path: str = ""      # module-scoped checks (terraform) set it
 
 
 @dataclass
@@ -37,6 +38,10 @@ class Policy:
     service: str
     check: Callable          # (parsed doc) -> list[Cause]
     success_message: str = "No issues found"
+    # custom policies (--config-policy) declare which parsed inputs
+    # their check understands: dockerfile | kubernetes | terraform |
+    # cloudformation | helm
+    file_types: tuple = ()
 
 
 # ------------------------------------------------------------ dockerfile

@@ -131,6 +131,7 @@ def detected_vulnerability_from_dict(x: dict) \
 def cause_metadata_from_dict(x: Optional[dict]) -> CauseMetadata:
     x = x or {}
     return CauseMetadata(
+        resource=x.get("Resource", ""),
         provider=x.get("Provider", ""),
         service=x.get("Service", ""),
         start_line=x.get("StartLine", 0),

@@ -69,6 +69,7 @@ class DetectedVulnerability:
 
 @dataclass
 class CauseMetadata:
+    resource: str = jfield("Resource", default="")
     provider: str = jfield("Provider", default="")
     service: str = jfield("Service", default="")
     start_line: int = jfield("StartLine", default=0)
