@@ -1,0 +1,184 @@
+"""DB lifecycle: OCI-layout distribution + metadata freshness
+(reference: pkg/db/db.go:90-184, pkg/oci/artifact.go:46-130;
+freshness cases mirror db_test.go's NeedsUpdate table)."""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from trivy_tpu.db.lifecycle import (DB_MEDIA_TYPE, SCHEMA_VERSION,
+                                    Metadata, db_dir, load_metadata,
+                                    needs_update, pack_db_archive,
+                                    read_oci_layout, save_metadata,
+                                    update_from_oci_layout,
+                                    write_oci_layout)
+
+UTC = datetime.timezone.utc
+NOW = datetime.datetime(2019, 10, 1, 0, 0, 0, tzinfo=UTC)
+
+
+def _meta(version=SCHEMA_VERSION, next_update=None,
+          downloaded_at=None) -> Metadata:
+    return Metadata(
+        version=version,
+        next_update=next_update or datetime.datetime(
+            2019, 9, 1, tzinfo=UTC),
+        downloaded_at=downloaded_at or datetime.datetime(
+            2019, 9, 1, tzinfo=UTC))
+
+
+class TestNeedsUpdate:
+    def test_first_run_needs_update(self, tmp_path):
+        assert needs_update(str(tmp_path), now=NOW) is True
+
+    def test_first_run_with_skip_errors(self, tmp_path):
+        with pytest.raises(ValueError, match="first run"):
+            needs_update(str(tmp_path), skip=True, now=NOW)
+
+    def test_newer_schema_errors(self, tmp_path):
+        save_metadata(str(tmp_path),
+                      _meta(version=SCHEMA_VERSION + 1))
+        with pytest.raises(ValueError, match="schema"):
+            needs_update(str(tmp_path), now=NOW)
+
+    def test_old_schema_needs_update(self, tmp_path):
+        save_metadata(str(tmp_path),
+                      _meta(version=SCHEMA_VERSION - 1))
+        assert needs_update(str(tmp_path), now=NOW) is True
+
+    def test_old_schema_with_skip_errors(self, tmp_path):
+        save_metadata(str(tmp_path),
+                      _meta(version=SCHEMA_VERSION - 1))
+        with pytest.raises(ValueError, match="old DB schema"):
+            needs_update(str(tmp_path), skip=True, now=NOW)
+
+    def test_fresh_inside_next_update(self, tmp_path):
+        save_metadata(str(tmp_path), _meta(
+            next_update=datetime.datetime(2019, 10, 2, tzinfo=UTC)))
+        assert needs_update(str(tmp_path), now=NOW) is False
+
+    def test_stale_past_next_update(self, tmp_path):
+        save_metadata(str(tmp_path), _meta(
+            next_update=datetime.datetime(2019, 9, 30, tzinfo=UTC)))
+        assert needs_update(str(tmp_path), now=NOW) is True
+
+    def test_recent_download_within_hour_is_fresh(self, tmp_path):
+        # db_test.go "skip downloading DB with recent DownloadedAt"
+        save_metadata(str(tmp_path), _meta(
+            next_update=datetime.datetime(2019, 9, 30, tzinfo=UTC),
+            downloaded_at=datetime.datetime(
+                2019, 9, 30, 23, 30, tzinfo=UTC)))
+        assert needs_update(str(tmp_path), now=NOW) is False
+
+    def test_old_download_past_hour_is_stale(self, tmp_path):
+        save_metadata(str(tmp_path), _meta(
+            next_update=datetime.datetime(2019, 9, 30, tzinfo=UTC),
+            downloaded_at=datetime.datetime(
+                2019, 9, 30, 22, 30, tzinfo=UTC)))
+        assert needs_update(str(tmp_path), now=NOW) is True
+
+    def test_skip_with_current_schema_ok(self, tmp_path):
+        save_metadata(str(tmp_path), _meta())
+        assert needs_update(str(tmp_path), skip=True,
+                            now=NOW) is False
+
+
+def _make_layout(tmp_path, with_meta=True):
+    from trivy_tpu.db.boltwriter import write_trivy_db
+    bolt = str(tmp_path / "src.db")
+    write_trivy_db(bolt, {"alpine 3.16": {"musl": {
+        "CVE-1": {"FixedVersion": "1.2.3-r1"}}}},
+        {"CVE-1": {"Severity": "HIGH"}})
+    meta = Metadata(
+        version=SCHEMA_VERSION,
+        next_update=datetime.datetime(2019, 10, 2, tzinfo=UTC),
+        updated_at=datetime.datetime(2019, 10, 1, tzinfo=UTC)) \
+        if with_meta else None
+    archive = pack_db_archive(open(bolt, "rb").read(), meta)
+    layout = str(tmp_path / "layout")
+    write_oci_layout(layout, archive)
+    return layout
+
+
+class TestOCILayout:
+    def test_read_layout(self, tmp_path):
+        layout = _make_layout(tmp_path)
+        blob, title = read_oci_layout(layout)
+        assert title == "db.tar.gz" and len(blob) > 0
+
+    def test_wrong_media_type_rejected(self, tmp_path):
+        layout = _make_layout(tmp_path)
+        # rewrite the manifest with a bad media type
+        idx = json.load(open(os.path.join(layout, "index.json")))
+        mdigest = idx["manifests"][0]["digest"].split(":")[1]
+        mpath = os.path.join(layout, "blobs", "sha256", mdigest)
+        manifest = json.load(open(mpath))
+        manifest["layers"][0]["mediaType"] = "application/foo"
+        open(mpath, "w").write(json.dumps(manifest))
+        with pytest.raises(ValueError, match="media type"):
+            read_oci_layout(layout)
+
+    def test_update_end_to_end(self, tmp_path):
+        layout = _make_layout(tmp_path)
+        cache = str(tmp_path / "cache")
+        meta = update_from_oci_layout(layout, cache, now=NOW)
+        assert os.path.exists(
+            os.path.join(db_dir(cache), "trivy.db"))
+        assert meta.downloaded_at == NOW
+        on_disk = load_metadata(cache)
+        assert on_disk.version == SCHEMA_VERSION
+        assert on_disk.next_update == datetime.datetime(
+            2019, 10, 2, tzinfo=UTC)
+        # the installed bolt file is readable by the production reader
+        from trivy_tpu.db.boltdb import load_trivy_db
+        store, n, _ = load_trivy_db(
+            os.path.join(db_dir(cache), "trivy.db"))
+        assert n == 1
+
+    def test_cli_db_update_and_scan(self, tmp_path):
+        """`db update --from-oci-layout` then a scan that auto-loads
+        the installed DB from the cache dir."""
+        layout = _make_layout(tmp_path)
+        cache = str(tmp_path / "cache")
+        r = subprocess.run(
+            [sys.executable, "-m", "trivy_tpu.cli", "db", "update",
+             "--from-oci-layout", layout, "--cache-dir", cache],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        assert "installed advisory DB schema v2" in r.stdout
+
+        sys.path.insert(0, os.path.join("/root/repo", "tests"))
+        from test_e2e_image import make_image_tar
+        img = make_image_tar(tmp_path, [{
+            "etc/alpine-release": b"3.16.2\n",
+            "lib/apk/db/installed":
+                b"P:musl\nV:1.2.2-r0\no:musl\n\n"}])
+        r = subprocess.run(
+            [sys.executable, "-m", "trivy_tpu.cli", "image",
+             "--input", img, "--cache-dir", cache, "--no-cache",
+             "--skip-db-update", "--backend", "cpu-ref",
+             "-f", "json"],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        rep = json.loads(r.stdout)
+        vulns = [v["VulnerabilityID"]
+                 for res in rep.get("Results") or []
+                 for v in res.get("Vulnerabilities") or []]
+        assert vulns == ["CVE-1"]
+
+
+def test_update_invalidates_stale_compiled(tmp_path):
+    """Review fix: a fresh `db update` must drop compiled tables
+    derived from the previous trivy.db — they'd silently shadow the
+    new install in the scan path otherwise."""
+    layout = _make_layout(tmp_path)
+    cache = str(tmp_path / "cache")
+    update_from_oci_layout(layout, cache, now=NOW)
+    stale = os.path.join(db_dir(cache), "compiled.npz")
+    open(stale, "wb").write(b"old tables")
+    update_from_oci_layout(layout, cache, now=NOW)
+    assert not os.path.exists(stale)

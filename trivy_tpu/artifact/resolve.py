@@ -29,8 +29,22 @@ from .image import ImageSource, load_image
 
 log = get_logger("artifact.resolve")
 
-DOCKER_SOCKETS = ("/var/run/docker.sock",
-                  "/run/podman/podman.sock")
+def _default_sockets() -> tuple:
+    """Docker then podman, system then rootless (the reference's
+    tryDockerd → tryPodman order; podman honors XDG_RUNTIME_DIR for
+    rootless sockets, ref pkg/fanal/image/daemon/podman.go)."""
+    out = ["/var/run/docker.sock", "/run/podman/podman.sock"]
+    xdg = os.environ.get("XDG_RUNTIME_DIR")
+    if xdg:
+        out.append(os.path.join(xdg, "podman", "podman.sock"))
+    try:
+        out.append(f"/run/user/{os.getuid()}/podman/podman.sock")
+    except AttributeError:       # pragma: no cover - non-posix
+        pass
+    return tuple(out)
+
+
+DOCKER_SOCKETS = _default_sockets()
 
 
 class ResolveError(ValueError):
@@ -95,15 +109,26 @@ class DaemonClient:
 
 
 class RegistryClient:
-    """The tryRemote leg. A real client speaks the OCI distribution
-    API (manifest + blob pulls with auth); this environment has zero
-    egress, so the default client only explains that."""
+    """The tryRemote leg: the real OCI distribution client
+    (artifact/registry.py — token auth, platform select, blob
+    pulls). Loopback registries work anywhere; remote hosts
+    additionally need network egress, and the error says so."""
+
+    def __init__(self, **kwargs):
+        from .registry import DistributionClient
+        self._client = DistributionClient(**kwargs)
 
     def pull(self, ref: str) -> ImageSource:
-        raise ResolveError(
-            f"cannot pull {ref!r}: registry access needs network "
-            "egress; provide --input <tarball> or an OCI layout "
-            "directory")
+        from .registry import RegistryError
+        try:
+            return self._client.pull(ref)
+        except (RegistryError, KeyError, ValueError, OSError) as e:
+            # KeyError/ValueError: malformed or schema-1 manifests
+            # (no 'config' key, non-JSON body); OSError: temp layout
+            raise ResolveError(
+                f"cannot pull {ref!r}: {e!r} (no egress here? "
+                f"provide --input <tarball> or an OCI layout "
+                f"directory)")
 
 
 def resolve_image(ref: str, name: Optional[str] = None,

@@ -85,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--compiled-db", default="",
                         help="load a compiled advisory DB "
                         "(path prefix from 'trivy-tpu db build')")
+        sp.add_argument("--skip-db-update", action="store_true",
+                        help="use the installed advisory DB even if "
+                        "its metadata says it is stale "
+                        "(ref --skip-db-update)")
         sp.add_argument("--secret-config", default="trivy-secret.yaml")
         sp.add_argument("--config-policy", default="",
                         help="comma-separated directories of custom "
@@ -188,6 +192,20 @@ def build_parser() -> argparse.ArgumentParser:
                        "native advisory store format)")
     build.add_argument("--output", "-o", required=True,
                        help="output path prefix (.npz)")
+    upd = dbsub.add_parser(
+        "update", help="install an advisory DB distribution into "
+        "the cache dir (ref pkg/db/db.go Download)")
+    upd.add_argument("--from-oci-layout", default="", required=True,
+                     help="local OCI image layout dir holding the "
+                     "trivy-db layer (what a registry pull yields; "
+                     "the network transport is an environment seam)")
+    upd.add_argument("--cache-dir",
+                     default=os.path.join(
+                         os.path.expanduser("~"), ".cache",
+                         "trivy-tpu"))
+    upd.add_argument("--compile", action="store_true",
+                     help="also compile the installed DB into "
+                     "TPU-resident tables at <cache>/db/compiled")
 
     srv = sub.add_parser("server", help="run in server mode "
                          "(owns cache + advisory DB + TPU dispatch)")
@@ -486,6 +504,8 @@ def run_server(args) -> int:
 
 
 def run_db(args) -> int:
+    if args.db_command == "update":
+        return _run_db_update(args)
     if args.db_command != "build":
         print("error: unknown db subcommand", file=sys.stderr)
         return 2
@@ -522,6 +542,33 @@ def run_db(args) -> int:
     return 0
 
 
+def _run_db_update(args) -> int:
+    """`db update --from-oci-layout` (ref pkg/db/db.go:146-184)."""
+    import time
+    from .db.lifecycle import db_dir, update_from_oci_layout
+    t0 = time.perf_counter()
+    try:
+        meta = update_from_oci_layout(args.from_oci_layout,
+                                      args.cache_dir)
+    except (OSError, ValueError) as e:
+        print(f"error: db update: {e}", file=sys.stderr)
+        return 1
+    print(f"installed advisory DB schema v{meta.version} -> "
+          f"{db_dir(args.cache_dir)} "
+          f"in {time.perf_counter() - t0:.2f}s")
+    if args.compile:
+        from .db import AdvisoryStore, CompiledDB
+        from .db.boltdb import load_trivy_db
+        store = AdvisoryStore()
+        _, n_adv, _ = load_trivy_db(
+            os.path.join(db_dir(args.cache_dir), "trivy.db"), store)
+        cdb = CompiledDB.compile(store)
+        out = os.path.join(db_dir(args.cache_dir), "compiled")
+        cdb.save(out)
+        print(f"compiled {n_adv} advisories -> {out}.npz")
+    return 0
+
+
 def _severities(arg: str) -> list:
     return [Severity.parse(s) for s in arg.split(",") if s.strip()]
 
@@ -534,6 +581,33 @@ def _store(args):
     if args.db_fixtures:
         load_fixtures([p for p in args.db_fixtures.split(",") if p],
                       store)
+    elif getattr(args, "cache_dir", ""):
+        # no explicit advisory source: use the DB installed by
+        # `db update` under the cache dir, honoring metadata
+        # freshness (ref pkg/db/db.go:90-120; the re-download it
+        # would trigger is an environment seam)
+        from .db.lifecycle import db_dir, needs_update
+        bolt = os.path.join(db_dir(args.cache_dir), "trivy.db")
+        if os.path.exists(bolt):
+            try:
+                stale = needs_update(
+                    args.cache_dir,
+                    skip=getattr(args, "skip_db_update", False))
+            except ValueError as e:
+                print(f"error: advisory DB: {e}", file=sys.stderr)
+                raise SystemExit(1)
+            if stale:
+                print("warning: advisory DB is stale (past "
+                      "NextUpdate); run 'db update' or pass "
+                      "--skip-db-update to silence",
+                      file=sys.stderr)
+            compiled = os.path.join(db_dir(args.cache_dir),
+                                    "compiled")
+            if os.path.exists(compiled + ".npz"):
+                from .db import CompiledDB
+                return CompiledDB.load(compiled)
+            from .db.boltdb import load_trivy_db
+            load_trivy_db(bolt, store)
     if getattr(args, "compile_db", False):
         from .db import CompiledDB
         return CompiledDB.compile(store)
