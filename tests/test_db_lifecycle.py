@@ -182,3 +182,18 @@ def test_update_invalidates_stale_compiled(tmp_path):
     open(stale, "wb").write(b"old tables")
     update_from_oci_layout(layout, cache, now=NOW)
     assert not os.path.exists(stale)
+
+
+class TestOffsetlessTimestamps:
+    def test_naive_metadata_times_treated_as_utc(self, tmp_path):
+        """metadata.json written without a UTC offset must not crash
+        needs_update with naive-vs-aware TypeError (advisor r4)."""
+        import json as _json
+        import os as _os
+        d = tmp_path / "db"
+        d.mkdir()
+        (d / "metadata.json").write_text(_json.dumps({
+            "Version": SCHEMA_VERSION,
+            "NextUpdate": "2099-01-01T00:00:00",
+            "DownloadedAt": "2019-09-01T00:00:00"}))
+        assert needs_update(str(tmp_path), now=NOW) is False

@@ -933,3 +933,81 @@ Resources:
                            start_line=3, end_line=5)
         back = cause_metadata_from_dict(cm.to_dict())
         assert back.resource == "aws_security_group.web"
+
+
+class TestAdvisorRound4:
+    """Regression tests for the round-4 advisor findings."""
+
+    def _scan(self, content, path="main.tf"):
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        return scan_config_files(
+            [ConfigFile(type="terraform", file_path=path,
+                        content=content)])
+
+    def test_heredoc_does_not_shift_line_numbers(self):
+        from trivy_tpu.misconf.hcl import parse_file
+        blocks = parse_file(
+            'resource "aws_iam_policy" "p" {\n'     # line 1
+            '  policy = <<EOT\n'                    # line 2
+            'hello\n'                               # line 3
+            'EOT\n'                                 # line 4
+            '}\n'                                   # line 5
+            'resource "aws_s3_bucket" "b" {\n'      # line 6
+            '  acl = "public-read"\n'               # line 7
+            '}\n')
+        blk = [b for b in blocks
+               if b.labels[:1] == ["aws_s3_bucket"]][0]
+        assert blk.start_line == 6
+        assert blk.attr_line("acl") == 7
+
+    def test_name_linked_aux_resources_recognized(self):
+        """aws_s3_bucket_versioning / ..._server_side_encryption /
+        ..._logging linked by LITERAL bucket name (not reference)
+        must count (advisor r4: only _linked_pab supported names)."""
+        out = self._scan(
+            b'resource "aws_s3_bucket" "b" {\n'
+            b'  bucket = "my-bucket"\n'
+            b'}\n'
+            b'resource "aws_s3_bucket_versioning" "v" {\n'
+            b'  bucket = "my-bucket"\n'
+            b'  versioning_configuration { status = "Enabled" }\n'
+            b'}\n'
+            b'resource '
+            b'"aws_s3_bucket_server_side_encryption_configuration"'
+            b' "e" {\n'
+            b'  bucket = "my-bucket"\n'
+            b'  rule {}\n'
+            b'}\n'
+            b'resource "aws_s3_bucket_logging" "l" {\n'
+            b'  bucket = "my-bucket"\n'
+            b'  target_bucket = "logs"\n'
+            b'}\n')
+        fails = {f.avd_id for f in out[0].failures}
+        assert "AVD-AWS-0090" not in fails    # versioning
+        assert "AVD-AWS-0088" not in fails    # encryption
+        assert "AVD-AWS-0089" not in fails    # logging
+
+    def test_chart_at_scan_root_consumes_chart_files(self):
+        """Chart.yaml / values.yaml of a chart at the scan root must
+        not be re-scanned as plain configs (advisor r4: '' + '/x'
+        never matched)."""
+        from trivy_tpu.misconf import scan_config_files
+        from trivy_tpu.types import ConfigFile
+        out = scan_config_files([
+            ConfigFile(type="helm", file_path="Chart.yaml",
+                       content=b"apiVersion: v2\nname: c\n"
+                               b"version: 0.1.0\n"),
+            ConfigFile(type="helm", file_path="values.yaml",
+                       content=b"Resources: {}\n"),
+            ConfigFile(type="helm",
+                       file_path="templates/deploy.yaml",
+                       content=b"apiVersion: apps/v1\n"
+                               b"kind: Deployment\n"
+                               b"metadata: {name: d}\n"),
+        ])
+        # only the rendered template may produce a result; the chart
+        # metadata files must not appear as scanned configs
+        paths = {m.file_path for m in out}
+        assert "Chart.yaml" not in paths
+        assert "values.yaml" not in paths

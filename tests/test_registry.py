@@ -294,3 +294,50 @@ class TestReviewFixes:
                     f"127.0.0.1:{reg.port}/org/app:1.0")
         finally:
             reg.stop()
+
+
+class TestAdvisorRound4:
+    def test_pinned_manifest_digest_verified(self):
+        """A manifest fetched by @sha256: digest must hash to that
+        digest before any blob digests inside it are trusted
+        (advisor r4: go-containerregistry validates this)."""
+        reg = FakeRegistry().start()
+        bogus = "sha256:" + "b" * 64
+        # registry serves SOME valid manifest under a digest key it
+        # does not actually hash to
+        reg.manifests[bogus] = reg.manifests["1.0"]
+        try:
+            with pytest.raises(RegistryError,
+                               match="manifest digest mismatch"):
+                DistributionClient().pull(
+                    f"127.0.0.1:{reg.port}/org/app@{bogus}")
+        finally:
+            reg.stop()
+
+    def test_pinned_manifest_digest_match_ok(self):
+        reg = FakeRegistry().start()
+        mdigest = next(k for k in reg.manifests
+                       if k.startswith("sha256:"))
+        try:
+            src = DistributionClient().pull(
+                f"127.0.0.1:{reg.port}/org/app@{mdigest}")
+            assert "lib/apk/db/installed" in _scan_src(src)
+            src.cleanup()
+        finally:
+            reg.stop()
+
+    def test_platform_selected_manifest_digest_verified(self):
+        """The image manifest resolved FROM a manifest list is also
+        digest-pinned; tampering with it must be caught."""
+        reg = FakeRegistry().start()
+        mdigest = next(k for k in reg.manifests
+                       if k.startswith("sha256:"))
+        ctype, body = reg.manifests[mdigest]
+        reg.manifests[mdigest] = (ctype, body + b" ")
+        try:
+            with pytest.raises(RegistryError,
+                               match="manifest digest mismatch"):
+                DistributionClient(platform="linux/amd64").pull(
+                    f"127.0.0.1:{reg.port}/org/app:multi")
+        finally:
+            reg.stop()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import atexit
 import base64
+import hashlib
 import json
 import os
 import shutil
@@ -181,7 +182,6 @@ class DistributionClient:
                      blob_dir: str, chunk: int = 1 << 20) -> None:
         """GET a blob streaming straight into the layout's blob
         store, verifying the digest incrementally."""
-        import hashlib
         url = self._base(registry) + f"/v2/{repo}/blobs/{digest}"
         headers = self._auth_headers(registry,
                                      "application/octet-stream")
@@ -223,10 +223,30 @@ class DistributionClient:
         raise RegistryError(
             f"no manifest for platform {self.platform!r}")
 
+    @staticmethod
+    def _verify_manifest(body: bytes, reference: str) -> None:
+        # A digest reference pins content: validate sha256(body)
+        # before trusting any digests inside it (go-containerregistry
+        # remote does the same; without this a misbehaving registry
+        # can serve arbitrary content for a pinned digest).
+        if ":" not in reference:
+            return                       # tag reference — nothing pinned
+        algo = reference.partition(":")[0]
+        if algo != "sha256":
+            # fail closed: skipping verification would reopen the hole
+            raise RegistryError(
+                f"unsupported digest algorithm {algo!r}")
+        got = hashlib.sha256(body).hexdigest()
+        if got != reference.partition(":")[2]:
+            raise RegistryError(
+                f"manifest digest mismatch: want {reference}, "
+                f"got sha256:{got}")
+
     def pull(self, ref: str) -> ImageSource:
         registry, repo, reference = parse_ref(ref)
         hdrs, body = self._get(
             registry, f"/v2/{repo}/manifests/{reference}")
+        self._verify_manifest(body, reference)
         ctype = (hdrs.get("Content-Type") or "").split(";")[0]
         manifest = json.loads(body)
         if ctype in (MT_MANIFEST_LIST, MT_OCI_INDEX) or \
@@ -234,6 +254,7 @@ class DistributionClient:
             digest = self._select_platform(manifest)
             hdrs, body = self._get(
                 registry, f"/v2/{repo}/manifests/{digest}")
+            self._verify_manifest(body, digest)
             manifest = json.loads(body)
             # the layout's index entry must describe the resolved
             # image manifest, not the list we started from
@@ -244,7 +265,6 @@ class DistributionClient:
         os.makedirs(blob_dir)
 
         def put(data: bytes) -> str:
-            import hashlib
             hexd = hashlib.sha256(data).hexdigest()
             with open(os.path.join(blob_dir, hexd), "wb") as f:
                 f.write(data)

@@ -51,10 +51,15 @@ def _parse_time(s: str) -> datetime.datetime:
     # before 3.11-style normalization
     s = s.replace("Z", "+00:00")
     try:
-        return datetime.datetime.fromisoformat(s)
+        t = datetime.datetime.fromisoformat(s)
     except ValueError:
         return datetime.datetime.fromtimestamp(
             0, tz=datetime.timezone.utc)
+    if t.tzinfo is None:
+        # offset-less timestamps would make needs_update comparisons
+        # raise (naive vs aware); treat them as UTC like Go's zero-loc
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    return t
 
 
 def _fmt_time(t: datetime.datetime) -> str:

@@ -27,6 +27,7 @@ WARNING: policy modules execute with full interpreter rights (like
 from __future__ import annotations
 
 import json as json_mod
+import posixpath
 import os
 
 from ..types import Misconfiguration
@@ -228,7 +229,6 @@ def _scan_terraform(tf_files: list) -> list:
     in (defsec reports per-resource-location files the same way).
     Successes attach to every file in the module."""
     from .hcl import parse_module
-    import posixpath
     by_dir: dict = {}
     for cf in tf_files:
         by_dir.setdefault(posixpath.dirname(cf.file_path), []).append(cf)
@@ -285,8 +285,11 @@ def _scan_helm_charts(config_files: list) -> tuple:
     out, consumed = [], set()
     for root, tpls in sorted(charts.items()):
         consumed.update(tpls)
-        consumed.add(root + "/Chart.yaml")
-        consumed.add(root + "/values.yaml")
+        # posixpath.join: a chart at the scan root has root == "" and
+        # plain concat would yield "/Chart.yaml", never matching the
+        # real path, so those files got re-scanned as plain configs
+        consumed.add(posixpath.join(root, "Chart.yaml"))
+        consumed.add(posixpath.join(root, "values.yaml"))
         rendered = render_chart(
             files, root, tpls, overrides,
             _options.helm_set_values)

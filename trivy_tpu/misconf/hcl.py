@@ -127,7 +127,10 @@ def _lex(src: str) -> list:
             body_end = tm.start() if tm else n
             body = src[body_start:body_end]
             toks.append(_Tok("string_lit", body, line))
-            line += text.count("\n") + body.count("\n") + 1
+            # the terminator line's own newline is NOT consumed here
+            # (pos stops at tm.end()); it is lexed next as an nl token,
+            # so counting it here would double-shift later line numbers
+            line += text.count("\n") + body.count("\n")
             pos = tm.end() if tm else n
             continue
         if kind == "nl":

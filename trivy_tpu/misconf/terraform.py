@@ -52,26 +52,50 @@ def _s3_buckets(blocks):
     return _resources(blocks, "aws_s3_bucket")
 
 
+def _aux_index(blocks, rtype: str) -> dict:
+    """One-pass lookup of aux resources (versioning / encryption /
+    logging / public-access-block) keyed by BOTH the reference target
+    (aws_s3_bucket.b) and the literal bucket-name string, so configs
+    that link by `bucket = "my-bucket"` count the same as references."""
+    idx = {}
+    for r in _resources(blocks, rtype):
+        v = r.attrs.get("bucket")
+        if v is None:
+            continue
+        t = _ref_target(v.value)
+        if t:
+            idx.setdefault(t, r)
+        if isinstance(v.value, str):
+            idx.setdefault(v.value, r)
+    return idx
+
+
+def _aux_lookup(idx: dict, bucket: Block):
+    r = idx.get(_addr(bucket))
+    if r is not None:
+        return r
+    name = bucket.attr("bucket")
+    return idx.get(name) if isinstance(name, str) else None
+
+
+def _linked_aux(blocks, bucket: Block, rtype: str):
+    """First resource of rtype linked to this bucket, or None."""
+    return _aux_lookup(_aux_index(blocks, rtype), bucket)
+
+
 def _linked_pab(blocks, bucket: Block):
     """public-access-block linked to this bucket by reference or by
     literal bucket name."""
-    name = bucket.attr("bucket")
-    for pab in _resources(blocks, "aws_s3_bucket_public_access_block"):
-        v = pab.attrs.get("bucket")
-        if v is None:
-            continue
-        if _ref_target(v.value) == _addr(bucket):
-            return pab
-        if isinstance(name, str) and v.value == name:
-            return pab
-    return None
+    return _linked_aux(
+        blocks, bucket, "aws_s3_bucket_public_access_block")
 
 
 def _check_s3_public_access_block(blocks) -> list:
     """AVD-AWS-0094 aws-s3-specify-public-access-block."""
     out = []
+    pabs = _aux_index(blocks, "aws_s3_bucket_public_access_block")
     for b in _s3_buckets(blocks):
-        if _linked_pab(blocks, b) is None:
+        if _aux_lookup(pabs, b) is None:
             out.append(_cause(
                 b, "Bucket does not have a corresponding public "
                    "access block."))
@@ -81,8 +105,10 @@ def _check_s3_public_access_block(blocks) -> list:
 def _pab_flag_check(flag: str, message: str):
     def check(blocks) -> list:
         out = []
+        pabs = _aux_index(
+            blocks, "aws_s3_bucket_public_access_block")
         for b in _s3_buckets(blocks):
-            pab = _linked_pab(blocks, b)
+            pab = _aux_lookup(pabs, b)
             if pab is None:
                 continue          # AVD-AWS-0094 reports the absence
             v = pab.attr(flag)
@@ -97,16 +123,12 @@ def _pab_flag_check(flag: str, message: str):
 def _check_s3_encryption(blocks) -> list:
     """AVD-AWS-0088 aws-s3-enable-bucket-encryption."""
     out = []
-    linked = {
-        _ref_target(r.attrs["bucket"].value)
-        for r in _resources(
-            blocks,
-            "aws_s3_bucket_server_side_encryption_configuration")
-        if "bucket" in r.attrs}
+    enc = _aux_index(
+        blocks, "aws_s3_bucket_server_side_encryption_configuration")
     for b in _s3_buckets(blocks):
         if b.first_block("server_side_encryption_configuration"):
             continue
-        if _addr(b) in linked:
+        if _aux_lookup(enc, b):
             continue
         out.append(_cause(
             b, "Bucket does not have encryption enabled"))
@@ -116,10 +138,7 @@ def _check_s3_encryption(blocks) -> list:
 def _check_s3_versioning(blocks) -> list:
     """AVD-AWS-0090 aws-s3-enable-versioning."""
     out = []
-    linked = {}
-    for r in _resources(blocks, "aws_s3_bucket_versioning"):
-        if "bucket" in r.attrs:
-            linked[_ref_target(r.attrs["bucket"].value)] = r
+    ver_idx = _aux_index(blocks, "aws_s3_bucket_versioning")
     for b in _s3_buckets(blocks):
         ver = b.first_block("versioning")
         if ver is not None:
@@ -129,7 +148,7 @@ def _check_s3_versioning(blocks) -> list:
                     b, "Bucket does not have versioning enabled",
                     ver.start_line))
             continue
-        r = linked.get(_addr(b))
+        r = _aux_lookup(ver_idx, b)
         if r is not None:
             cfg = r.first_block("versioning_configuration")
             if cfg is not None and cfg.attr("status") not in (
@@ -160,12 +179,9 @@ def _check_s3_public_acl(blocks) -> list:
 def _check_s3_logging(blocks) -> list:
     """AVD-AWS-0089 aws-s3-enable-bucket-logging."""
     out = []
-    linked = {
-        _ref_target(r.attrs["bucket"].value)
-        for r in _resources(blocks, "aws_s3_bucket_logging")
-        if "bucket" in r.attrs}
+    logging_idx = _aux_index(blocks, "aws_s3_bucket_logging")
     for b in _s3_buckets(blocks):
-        if b.first_block("logging") or _addr(b) in linked:
+        if b.first_block("logging") or _aux_lookup(logging_idx, b):
             continue
         if isinstance(b.attr("acl"), str) and \
                 b.attr("acl") == "log-delivery-write":
