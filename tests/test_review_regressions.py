@@ -168,3 +168,34 @@ def test_redhat_family_supported():
     assert [v.vulnerability_id for v in vulns] == ["CVE-2020-1971"]
     vulns, _ = ospkg_detect("centos", "8", None, pkgs, store)
     assert len(vulns) == 1
+
+
+def test_batch_shared_layer_secret_lands_on_both_images(tmp_path):
+    """Two images sharing the SAME layer (identical bytes → one
+    cached blob) with a secret in it: the deferred sieve collect
+    must re-merge secrets for the image whose analysis saw the blob
+    as already-cached and collected nothing itself (review r5)."""
+    from tests.test_e2e_image import make_image_tar
+    from trivy_tpu.runtime.batch import BatchScanRunner
+    from trivy_tpu.types import ScanOptions
+
+    shared = {"srv/cfg.conf": b"token=ghp_" + b"C" * 36 + b"\n"}
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    tar1 = make_image_tar(tmp_path / "a", [shared])
+    tar2 = make_image_tar(
+        tmp_path / "b",
+        [shared, {"etc/extra.txt": b"nothing here\n"}])
+
+    runner = BatchScanRunner(backend="cpu-ref")
+    res = runner.scan_paths(
+        [tar1, tar2],
+        ScanOptions(backend="cpu-ref", security_checks=["secret"]))
+    assert res[0].error == "" and res[1].error == ""
+
+    def secret_count(r):
+        return sum(len(x.secrets) for x in r.report.results)
+
+    assert secret_count(res[0]) == 1
+    assert secret_count(res[1]) == 1, \
+        "shared cached layer must surface the secret on BOTH images"

@@ -85,9 +85,9 @@ def test_run_gate_filters_whole_file_scans():
                ("b.txt", b'aws_secret_access_key = "'
                 + b"A" * 40 + b'"\n')]
     from trivy_tpu.secret.batch import _FileEntry
-    cands = b._candidates([
+    cands = b._decode(b._dispatch([
         _FileEntry(path=p, content=c, index=i)
-        for i, (p, c) in enumerate(entries)])
+        for i, (p, c) in enumerate(entries)]))
     assert aws_secret not in cands.get(0, set())
     assert aws_secret in cands.get(1, set())
 

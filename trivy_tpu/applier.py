@@ -63,7 +63,6 @@ class _Nested:
 
 def apply_layers(layers: list) -> ArtifactDetail:
     nested = _Nested()
-    secrets_map: dict = {}
     merged = ArtifactDetail()
 
     for layer in layers:
@@ -89,10 +88,6 @@ def apply_layers(layers: list) -> ArtifactDetail:
             config.layer = Layer(digest=layer.digest,
                                  diff_id=layer.diff_id)
             nested.set_value(f"{config.file_path}/type:config", config)
-        for secret in layer.secrets:
-            _merge_secret(secrets_map, secret,
-                          Layer(digest=layer.digest,
-                                diff_id=layer.diff_id))
         for lic in layer.licenses:
             lic.layer = Layer(digest=layer.digest,
                               diff_id=layer.diff_id)
@@ -115,7 +110,7 @@ def apply_layers(layers: list) -> ArtifactDetail:
         elif value.__class__.__name__ == "CustomResource":
             merged.custom_resources.append(value)
 
-    merged.secrets = [secrets_map[k] for k in sorted(secrets_map)]
+    merged.secrets = merge_layer_secrets(layers)
 
     # dpkg license files merge into package records (docker.go:188-)
     dpkg_licenses = {}
@@ -167,6 +162,22 @@ def _origin_layer_lib(file_path, lib, layers) -> tuple:
                 if (p.name, p.version) == (lib.name, lib.version):
                     return layer.digest, layer.diff_id
     return "", ""
+
+
+def merge_layer_secrets(layers: list) -> list:
+    """Stand-alone secret merge across layers, identical to the one
+    apply_layers performs inline (whiteouts never delete secrets).
+    Lets the batch runner re-derive detail.secrets AFTER a deferred
+    sieve collect, without re-applying whole layers."""
+    secrets_map: dict = {}
+    for layer in layers:
+        if layer is None:
+            continue
+        for secret in layer.secrets:
+            _merge_secret(secrets_map, secret,
+                          Layer(digest=layer.digest,
+                                diff_id=layer.diff_id))
+    return [secrets_map[k] for k in sorted(secrets_map)]
 
 
 def _merge_secret(secrets_map: dict, new: Secret, layer) -> None:

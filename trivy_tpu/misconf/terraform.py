@@ -78,18 +78,6 @@ def _aux_lookup(idx: dict, bucket: Block):
     return idx.get(name) if isinstance(name, str) else None
 
 
-def _linked_aux(blocks, bucket: Block, rtype: str):
-    """First resource of rtype linked to this bucket, or None."""
-    return _aux_lookup(_aux_index(blocks, rtype), bucket)
-
-
-def _linked_pab(blocks, bucket: Block):
-    """public-access-block linked to this bucket by reference or by
-    literal bucket name."""
-    return _linked_aux(
-        blocks, bucket, "aws_s3_bucket_public_access_block")
-
-
 def _check_s3_public_access_block(blocks) -> list:
     """AVD-AWS-0094 aws-s3-specify-public-access-block."""
     out = []

@@ -18,6 +18,7 @@ superset — exactness is restored by host verification).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
@@ -190,6 +191,103 @@ def run_blockmask(segments: np.ndarray, table: CodeTable,
         out = code_blockmask(jnp.asarray(segments),
                              *(jnp.asarray(c) for c in codes))
     return np.asarray(out)[:B, :K]
+
+
+SIEVE_CAP = 4096       # compacted-fetch capacity (hit segments)
+
+
+@functools.lru_cache(maxsize=8)
+def make_fused_sieve(literals: tuple, run_specs: tuple,
+                     platform: str):
+    """ONE jit dispatch for both sieve stages over a device-resident
+    segment buffer: literal blockmask + class-run hits.
+
+    Host↔device crossings dominate the sieve under the tunneled
+    chip, so the segment buffer crosses ONCE, both kernels read the
+    resident copy, and the fetch is COMPACTED on device: only the
+    rows of segments with ≥1 code hit come back (as uint16 —
+    N_BLOCKS = 16 bits used — gathered at fixed capacity SIEVE_CAP
+    so shapes stay static under jit). Run hits are [B, n_specs]
+    bool and come back whole: a file's mandatory class-run can sit
+    in a segment with no keyword hit.
+
+    Returns (per jit call over [B, L] segments):
+      nhit   — i32 scalar, segments with ≥1 code hit
+      idx    — [CAP] i32, their row indices (first nhit valid,
+               ascending; CAP = min(SIEVE_CAP, B))
+      cmasks — [CAP, K] uint16 blockmask rows for those segments
+      hits   — [B, n_specs] bool class-run presence
+
+    When nhit > CAP the compacted fetch is insufficient — callers
+    fall back to the full-mask variant (make_full_sieve).
+
+    Cached on (literals, run_specs, platform) so scanner instances
+    share the compile — platform is in the key because
+    dryrun_multichip re-points JAX at CPU mid-process."""
+    table = build_code_table(literals)
+    codes = _pad_codes((table.lo, table.hi, table.lo_mask,
+                        table.hi_mask))
+    use_pallas = platform != "cpu"
+    if use_pallas:
+        from .keywords_pallas import code_blockmask_pallas
+    from .runs import run_hits_impl
+    cdev = tuple(jnp.asarray(c) for c in codes)
+
+    @jax.jit
+    def fused(segments: jax.Array) -> tuple:
+        if use_pallas:
+            masks = code_blockmask_pallas(segments, *cdev)
+        else:
+            masks = code_blockmask_impl(segments, *cdev)
+        # slice off pad codes BEFORE seg_any: pad entries (0 with
+        # full masks) hit 8-NUL windows, so counting their columns
+        # would mark every zero-padded tail segment as a hit and
+        # defeat the compaction whenever n_codes < padded width
+        masks = masks[:, :table.n_codes].astype(jnp.uint16)
+        B = segments.shape[0]
+        cap = min(SIEVE_CAP, B)
+        seg_any = (masks != 0).any(axis=1)
+        nhit = seg_any.sum(dtype=jnp.int32)
+        idx = jnp.nonzero(seg_any, size=cap, fill_value=0)[0]
+        cmasks = masks[idx]
+        if run_specs:
+            hits = run_hits_impl(segments, run_specs)
+        else:
+            hits = jnp.zeros((B, 0), jnp.bool_)
+        return nhit, idx, cmasks, hits
+
+    return fused
+
+
+@functools.lru_cache(maxsize=8)
+def make_full_sieve(literals: tuple, run_specs: tuple,
+                    platform: str):
+    """Full-fetch variant of make_fused_sieve for the rare batch
+    where more than SIEVE_CAP segments hit: returns the whole
+    [B, K] uint16 mask array plus [B, n_specs] run hits."""
+    table = build_code_table(literals)
+    codes = _pad_codes((table.lo, table.hi, table.lo_mask,
+                        table.hi_mask))
+    use_pallas = platform != "cpu"
+    if use_pallas:
+        from .keywords_pallas import code_blockmask_pallas
+    from .runs import run_hits_impl
+    cdev = tuple(jnp.asarray(c) for c in codes)
+
+    @jax.jit
+    def full(segments: jax.Array) -> tuple:
+        if use_pallas:
+            masks = code_blockmask_pallas(segments, *cdev)
+        else:
+            masks = code_blockmask_impl(segments, *cdev)
+        masks = masks[:, :table.n_codes]    # drop pad-code columns
+        if run_specs:
+            hits = run_hits_impl(segments, run_specs)
+        else:
+            hits = jnp.zeros((segments.shape[0], 0), jnp.bool_)
+        return masks.astype(jnp.uint16), hits
+
+    return full
 
 
 def _bucket(n: int) -> int:

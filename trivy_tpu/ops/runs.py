@@ -60,6 +60,18 @@ def _erode(m: jax.Array, R: int) -> jax.Array:
     return e
 
 
+def run_hits_impl(segments: jax.Array, specs: tuple) -> jax.Array:
+    """Unjitted [B, L] → [B, n_specs] bool run detector body (so the
+    batch scanner can fuse it with the literal sieve into one
+    dispatch over a device-resident segment buffer)."""
+    x = segments.astype(jnp.int32)
+    cols = []
+    for spec in specs:
+        m = _membership(x, spec)
+        cols.append(_erode(m, spec.runlen).any(axis=1))
+    return jnp.stack(cols, axis=1)
+
+
 @functools.lru_cache(maxsize=16)
 def make_run_hits(specs: tuple):
     """Compile a jitted [B, L] → [B, n_specs] bool run detector.
@@ -68,12 +80,7 @@ def make_run_hits(specs: tuple):
 
     @jax.jit
     def run_hits(segments: jax.Array) -> jax.Array:
-        x = segments.astype(jnp.int32)
-        cols = []
-        for spec in specs:
-            m = _membership(x, spec)
-            cols.append(_erode(m, spec.runlen).any(axis=1))
-        return jnp.stack(cols, axis=1)
+        return run_hits_impl(segments, specs)
 
     return run_hits
 

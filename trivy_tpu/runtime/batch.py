@@ -91,16 +91,19 @@ class BatchScanRunner:
             artifacts.append(a)
         analyze_s = _time.perf_counter() - t0
 
-        # ---- phase 2: ONE sieve dispatch over all images ----
+        # ---- phase 2a: ENQUEUE the sieve dispatch (async) ----
+        # the device sieves while the host squashes + preps interval
+        # jobs (phases 3-4); results are collected in 2b below —
+        # apply_layers' secret merge is re-derived afterwards via
+        # applier.merge_layer_secrets, which is exactly the secret
+        # part of the squash
         t0 = _time.perf_counter()
         collected = [c for a in artifacts for c in a.collected]
         sec_stats: dict = {}       # only this batch's, never stale
+        sieve_handle = None
         if scan_secrets and collected:
-            found = self.secret_scanner.scan_files(
+            sieve_handle = self.secret_scanner.dispatch_files(
                 [(p, c) for _, p, c in collected])
-            _patch_blobs(self.cache, artifacts, found)
-            sec_stats = dict(getattr(self.secret_scanner,
-                                     "stats", {}))
         secret_s = _time.perf_counter() - t0
 
         # ---- phase 3: squash + advisory join (host) ----
@@ -127,6 +130,24 @@ class BatchScanRunner:
                                           mesh=self.mesh):
             detected_by_image.setdefault(idx, []).append(payload)
         interval_s = _time.perf_counter() - t0
+
+        # ---- phase 2b: collect sieve results + late secret merge ----
+        t0 = _time.perf_counter()
+        if sieve_handle is not None:
+            from ..applier import merge_layer_secrets
+            found = self.secret_scanner.collect(sieve_handle)
+            _patch_blobs(self.cache, artifacts, found)
+            sec_stats = dict(getattr(self.secret_scanner,
+                                     "stats", {}))
+            # re-merge EVERY artifact: a patched blob may be shared
+            # with artifacts whose own `collected` is empty (fleets
+            # share layers — the cached-layer case), and their
+            # prepare() ran before the patch landed
+            for a, p in zip(artifacts, prepared):
+                blobs = [self.cache.get_blob(b)
+                         for b in a.reference.blob_ids]
+                p.detail.secrets = merge_layer_secrets(blobs)
+        secret_s += _time.perf_counter() - t0
 
         from ..detect import batch as detect_batch
         self.last_stats = {

@@ -30,7 +30,8 @@ from typing import Iterable, Optional
 
 import numpy as np
 
-from ..ops.keywords import MAX_CODE_LEN, N_BLOCKS, run_blockmask
+from ..ops.keywords import (MAX_CODE_LEN, N_BLOCKS, pad_batch,
+                            run_blockmask)
 from ..utils import get_logger
 from .plan import ScanPlan, build_scan_plan
 from .scanner import Scanner
@@ -112,14 +113,34 @@ class BatchSecretScanner:
 
         ``self.stats`` afterwards holds the sieve selectivity and the
         host/device time split for this call (bench + tracing)."""
+        return self.collect(self.dispatch_files(files))
+
+    def dispatch_files(self, files: Iterable):
+        """Async half of scan_files: build the segment buffer and
+        ENQUEUE the sieve dispatch without fetching results. The
+        device computes while the caller does host work (squash,
+        interval job prep); ``collect`` fetches + verifies.
+
+        On the cpu-ref backend and the mesh path the dispatch runs
+        eagerly (those paths return host arrays already)."""
         import time as _time
         entries = [
             _FileEntry(path=p, content=c, index=i)
             for i, (p, c) in enumerate(files)
         ]
         t0 = _time.perf_counter()
-        candidates = self._candidates(entries)
-        sieve_s = _time.perf_counter() - t0
+        handle = self._dispatch(entries)
+        handle["dispatch_s"] = _time.perf_counter() - t0
+        return handle
+
+    def collect(self, handle) -> list:
+        """Blocking half of scan_files: fetch sieve outputs, decode
+        candidates, run the windowed/whole-file exact verify."""
+        import time as _time
+        entries = handle["entries"]
+        t0 = _time.perf_counter()
+        candidates = self._decode(handle)
+        sieve_s = handle["dispatch_s"] + _time.perf_counter() - t0
 
         t0 = _time.perf_counter()
         results = []
@@ -162,39 +183,108 @@ class BatchSecretScanner:
 
     # --- sieve stages ---
 
-    def _candidates(self, entries: list) -> dict:
+    def _dispatch(self, entries: list) -> dict:
+        """Segment + enqueue the sieve. Returns the handle `_decode`
+        consumes; on the fused path the jax arrays inside are NOT yet
+        materialized — the device computes in the background."""
+        import time as _time
+        self._device_s = 0.0
+        buf, seg_file, seg_pos = self._segment(entries)
+        handle = {"entries": entries, "buf": buf,
+                  "seg_file": seg_file, "seg_pos": seg_pos}
+        if buf.shape[0] == 0:
+            handle["mode"] = "empty"
+            return handle
+        if self.backend == "cpu-ref" or self.mesh is not None:
+            t0 = _time.perf_counter()
+            handle["masks"] = run_blockmask(
+                buf, self.plan.table, backend=self.backend,
+                mesh=self.mesh)
+            handle["mode"] = "host"
+            self._device_s += _time.perf_counter() - t0
+            return handle
+        # fused path: the segment buffer crosses the tunnel ONCE,
+        # blockmask + run hits come out of a single dispatch on the
+        # resident copy, and the mask fetch is compacted to the hit
+        # rows (selectivity makes this ~1% of the full [B, K] array;
+        # the >CAP fallback fetches everything)
+        import jax
+        from ..ops.keywords import make_fused_sieve
+        t0 = _time.perf_counter()
+        key = (self.plan.table.literals,
+               tuple(self.plan.run_specs),
+               jax.default_backend())
+        dev = jax.device_put(pad_batch(buf))
+        nhit, idx, cm, h = make_fused_sieve(*key)(dev)
+        handle.update(mode="fused", key=key, dev=dev, nhit=nhit,
+                      idx=idx, cm=cm, h=h)
+        self._device_s += _time.perf_counter() - t0
+        return handle
+
+    def _decode(self, handle: dict) -> dict:
         """file index → {rule index: verify spans or None}.
 
         A rule maps to merged byte spans when its window proof is
         extraction-exact (the host then regexes only those spans); to
         None when it needs the reference's whole-file scan."""
         import time as _time
-        self._device_s = 0.0
-        buf, seg_file, seg_pos = self._segment(entries)
-        if buf.shape[0] == 0:
+        if handle["mode"] == "empty":
             return {}
+        entries = handle["entries"]
+        buf = handle["buf"]
+        seg_file = handle["seg_file"]
+        seg_pos = handle["seg_pos"]
+        run_fetch = None
         t0 = _time.perf_counter()
-        masks = run_blockmask(buf, self.plan.table,
-                              backend=self.backend, mesh=self.mesh)
+        if handle["mode"] == "host":
+            masks = handle["masks"]
+            seg_nz, code_nz = np.nonzero(masks)
+            hit_vals = masks[seg_nz, code_nz]
+        else:
+            B = buf.shape[0]
+            K = self.plan.table.n_codes
+            nhit = int(handle["nhit"])
+            cm = handle["cm"]
+            if nhit > min(cm.shape[0], handle["dev"].shape[0]):
+                from ..ops.keywords import make_full_sieve
+                m, h = make_full_sieve(*handle["key"])(handle["dev"])
+                masks = np.asarray(m)[:B, :K]
+                seg_nz, code_nz = np.nonzero(masks)
+                hit_vals = masks[seg_nz, code_nz]
+            else:
+                h = handle["h"]
+                rows = np.asarray(cm)[:nhit, :K]
+                ridx = np.asarray(handle["idx"])[:nhit]
+                rnz, code_nz = np.nonzero(rows)
+                # padded rows (index ≥ B) never hit: zero segments
+                seg_nz = ridx[rnz]
+                hit_vals = rows[rnz, code_nz]
+            run_fetch = np.asarray(h)[:B]
         self._device_s += _time.perf_counter() - t0
 
-        # run-hits dispatch is lazy: it fires at most once per batch,
+        # run-hits decode is lazy: it happens at most once per batch,
         # and only when a run-gated rule survives its keyword gate
         runs_cache: dict = {}
         runs_ready = [False]
 
         def file_runs(fidx) -> set:
             if not runs_ready[0]:
-                runs_cache.update(self._file_runs(buf, seg_file))
+                if run_fetch is not None:
+                    for si, sp in zip(*np.nonzero(run_fetch)):
+                        runs_cache.setdefault(
+                            seg_file[int(si)], set()).add(int(sp))
+                else:
+                    runs_cache.update(
+                        self._file_runs(buf, seg_file))
                 runs_ready[0] = True
             return runs_cache.get(fidx, set())
 
         # per file: code → merged list of (segment file-offset, bitmask)
         file_codes: dict = {}
-        seg_nz, code_nz = np.nonzero(masks)
-        for si, ci in zip(seg_nz.tolist(), code_nz.tolist()):
+        for si, ci, mv in zip(seg_nz.tolist(), code_nz.tolist(),
+                              hit_vals.tolist()):
             fc = file_codes.setdefault(seg_file[si], {})
-            fc.setdefault(ci, []).append((seg_pos[si], int(masks[si, ci])))
+            fc.setdefault(ci, []).append((seg_pos[si], int(mv)))
 
         by_index = {fe.index: fe for fe in entries}
         blk = self.seg_len // N_BLOCKS
