@@ -413,6 +413,10 @@ def bench_images() -> dict:
                 "mb_scanned": round(
                     sec.get("bytes_total", 0) / 1e6, 1),
                 "verify_tail_s": sec.get("verify_s", 0.0),
+                # how much whole-file host scanning remains vs
+                # extraction-exact windowed verify (VERDICT r4 weak #2)
+                "rules_windowed": sec.get("rules_windowed", 0),
+                "rules_wholefile": sec.get("rules_wholefile", 0),
             },
             "findings": {"vulns": n_vulns, "secrets": n_secrets},
         }
@@ -493,9 +497,112 @@ def bench_sboms() -> dict:
     }
 
 
+def bench_mesh_scaling() -> dict:
+    """Strong-scaling curve over a virtual CPU mesh: the SAME image
+    fleet scanned with 1/2/4/8 mesh devices (sharded sieve + sharded
+    interval kernels). Run in a subprocess with
+    JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8 —
+    multi-chip hardware is not reachable from this bench box, so the
+    curve shows how the batch dims shard, not absolute speed."""
+    import tempfile
+
+    import jax
+
+    # axon's sitecustomize pins the TPU platform at startup, so env
+    # vars alone are too late — the config update is authoritative
+    # (must run before any backend-initializing call)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+    from trivy_tpu.parallel import make_mesh
+    from trivy_tpu.runtime import BatchScanRunner
+
+    n_img = 64
+    devices = jax.devices()
+    counts = [c for c in (1, 2, 4, 8) if c <= len(devices)]
+    out: dict = {"devices": counts, "images": n_img,
+                 "total_s": [], "phase": []}
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = make_fleet(tmp, n_img)
+        store = make_store()
+        base = None
+        for c in counts:
+            mesh = make_mesh(c)
+            # warm compile per mesh size with a throwaway runner —
+            # a fresh (cold-cache) runner is timed, so the scan does
+            # real work instead of replaying cached blobs
+            BatchScanRunner(store=store, backend="tpu",
+                            mesh=mesh).scan_paths(paths)
+            runner = BatchScanRunner(store=store, backend="tpu",
+                                     mesh=mesh)
+            t0 = time.perf_counter()
+            results = runner.scan_paths(paths)
+            dt = time.perf_counter() - t0
+            norm = _norm(results)
+            if base is None:
+                base = norm
+            else:
+                assert norm == base, \
+                    f"mesh={c} findings diverge from mesh=1"
+            out["total_s"].append(round(dt, 3))
+            out["phase"].append({
+                k: v for k, v in runner.last_stats.items()
+                if k.endswith("_s")})
+    return out
+
+
+def _run_config(cfg: str) -> dict:
+    return {"images": bench_images, "sboms": bench_sboms,
+            "mesh": bench_mesh_scaling}[cfg]()
+
+
+def _subprocess_config(cfg: str) -> dict:
+    """One bench config in its own process: per-config heap/allocator
+    isolation (the 10k-SBOM decode measured 2x slower when run in the
+    image bench's dirtied process) and a clean JAX runtime each time."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    if cfg == "mesh":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--config", cfg],
+        capture_output=True, text=True, env=env, timeout=1800)
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"bench config {cfg} failed:\n{p.stderr[-2000:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def _spread(values: list) -> dict:
+    vs = sorted(values)
+    return {"min": vs[0], "median": vs[len(vs) // 2], "max": vs[-1],
+            "runs": len(vs)}
+
+
+RUNS = 3        # per config — the tunnel has ~2x run-to-run variance
+
+
 def main() -> None:
-    images = bench_images()
-    sboms = bench_sboms()
+    import sys
+    if "--config" in sys.argv:
+        cfg = sys.argv[sys.argv.index("--config") + 1]
+        print(json.dumps(_run_config(cfg)))
+        return
+
+    image_runs = [_subprocess_config("images") for _ in range(RUNS)]
+    sbom_runs = [_subprocess_config("sboms") for _ in range(RUNS)]
+    mesh = _subprocess_config("mesh")
+
+    # median run (by headline metric) is the reported one
+    images = sorted(image_runs,
+                    key=lambda r: r["images_per_sec"])[RUNS // 2]
+    sboms = sorted(sbom_runs,
+                   key=lambda r: r["sboms_per_sec"])[RUNS // 2]
     ips = images["images_per_sec"]
     print(json.dumps({
         "metric": "images_scanned_per_sec",
@@ -503,8 +610,15 @@ def main() -> None:
         "unit": "images/s (vuln+secret, realistic corpus)",
         "vs_baseline": round(
             ips / max(1e-9, images["cpu_ref_images_per_sec"]), 2),
+        "spread": {
+            "images_per_sec": _spread(
+                [r["images_per_sec"] for r in image_runs]),
+            "sboms_per_sec": _spread(
+                [r["sboms_per_sec"] for r in sbom_runs]),
+        },
         "image_bench": images,
         "sbom_bench": sboms,
+        "mesh_scaling": mesh,
     }))
 
 

@@ -123,16 +123,27 @@ def apply_layers(layers: list) -> ArtifactDetail:
             kept.append(lic)
     merged.licenses = kept
 
+    # single-layer artifacts (SBOMs, fs scans) need no search: every
+    # merged record can only come from that one layer
+    real = [l for l in layers if l is not None]
+    single = real[0] if len(real) == 1 else None
+
     for pkg in merged.packages:
-        digest, diff_id = _origin_layer_pkg(pkg, layers)
+        if single is not None:
+            digest, diff_id = single.digest, single.diff_id
+        else:
+            digest, diff_id = _origin_layer_pkg(pkg, layers)
         pkg.layer = Layer(digest=digest, diff_id=diff_id)
         if pkg.name in dpkg_licenses:
             pkg.licenses = dpkg_licenses[pkg.name]
 
     for app in merged.applications:
         for lib in app.libraries:
-            digest, diff_id = _origin_layer_lib(app.file_path, lib,
-                                                layers)
+            if single is not None:
+                digest, diff_id = single.digest, single.diff_id
+            else:
+                digest, diff_id = _origin_layer_lib(
+                    app.file_path, lib, layers)
             lib.layer = Layer(digest=digest, diff_id=diff_id)
 
     _aggregate(merged)

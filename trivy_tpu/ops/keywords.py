@@ -196,6 +196,26 @@ def run_blockmask(segments: np.ndarray, table: CodeTable,
 SIEVE_CAP = 4096       # compacted-fetch capacity (hit segments)
 
 
+def _sieve_blockmask_fn(literals: tuple, platform: str):
+    """Shared setup for the fused/full sieve factories: one place
+    builds the code table, pads it, picks the pallas vs XLA kernel,
+    and stages device constants — so the compacted and fallback
+    paths cannot drift apart. Returns (n_codes, blockmask_fn)."""
+    table = build_code_table(literals)
+    codes = _pad_codes((table.lo, table.hi, table.lo_mask,
+                        table.hi_mask))
+    cdev = tuple(jnp.asarray(c) for c in codes)
+    if platform != "cpu":
+        from .keywords_pallas import code_blockmask_pallas
+
+        def blockmask(segments):
+            return code_blockmask_pallas(segments, *cdev)
+    else:
+        def blockmask(segments):
+            return code_blockmask_impl(segments, *cdev)
+    return table.n_codes, blockmask
+
+
 @functools.lru_cache(maxsize=8)
 def make_fused_sieve(literals: tuple, run_specs: tuple,
                      platform: str):
@@ -224,26 +244,17 @@ def make_fused_sieve(literals: tuple, run_specs: tuple,
     Cached on (literals, run_specs, platform) so scanner instances
     share the compile — platform is in the key because
     dryrun_multichip re-points JAX at CPU mid-process."""
-    table = build_code_table(literals)
-    codes = _pad_codes((table.lo, table.hi, table.lo_mask,
-                        table.hi_mask))
-    use_pallas = platform != "cpu"
-    if use_pallas:
-        from .keywords_pallas import code_blockmask_pallas
+    n_codes, blockmask = _sieve_blockmask_fn(literals, platform)
     from .runs import run_hits_impl
-    cdev = tuple(jnp.asarray(c) for c in codes)
 
     @jax.jit
     def fused(segments: jax.Array) -> tuple:
-        if use_pallas:
-            masks = code_blockmask_pallas(segments, *cdev)
-        else:
-            masks = code_blockmask_impl(segments, *cdev)
+        masks = blockmask(segments)
         # slice off pad codes BEFORE seg_any: pad entries (0 with
         # full masks) hit 8-NUL windows, so counting their columns
         # would mark every zero-padded tail segment as a hit and
         # defeat the compaction whenever n_codes < padded width
-        masks = masks[:, :table.n_codes].astype(jnp.uint16)
+        masks = masks[:, :n_codes].astype(jnp.uint16)
         B = segments.shape[0]
         cap = min(SIEVE_CAP, B)
         seg_any = (masks != 0).any(axis=1)
@@ -260,32 +271,18 @@ def make_fused_sieve(literals: tuple, run_specs: tuple,
 
 
 @functools.lru_cache(maxsize=8)
-def make_full_sieve(literals: tuple, run_specs: tuple,
-                    platform: str):
-    """Full-fetch variant of make_fused_sieve for the rare batch
+def make_full_sieve(literals: tuple, platform: str):
+    """Full-mask variant of make_fused_sieve for the rare batch
     where more than SIEVE_CAP segments hit: returns the whole
-    [B, K] uint16 mask array plus [B, n_specs] run hits."""
-    table = build_code_table(literals)
-    codes = _pad_codes((table.lo, table.hi, table.lo_mask,
-                        table.hi_mask))
-    use_pallas = platform != "cpu"
-    if use_pallas:
-        from .keywords_pallas import code_blockmask_pallas
-    from .runs import run_hits_impl
-    cdev = tuple(jnp.asarray(c) for c in codes)
+    [B, K] uint16 mask array. Run hits are NOT recomputed — the
+    fused dispatch already produced them and callers keep that
+    array."""
+    n_codes, blockmask = _sieve_blockmask_fn(literals, platform)
 
     @jax.jit
-    def full(segments: jax.Array) -> tuple:
-        if use_pallas:
-            masks = code_blockmask_pallas(segments, *cdev)
-        else:
-            masks = code_blockmask_impl(segments, *cdev)
-        masks = masks[:, :table.n_codes]    # drop pad-code columns
-        if run_specs:
-            hits = run_hits_impl(segments, run_specs)
-        else:
-            hits = jnp.zeros((segments.shape[0], 0), jnp.bool_)
-        return masks.astype(jnp.uint16), hits
+    def full(segments: jax.Array) -> jax.Array:
+        # drop pad-code columns
+        return blockmask(segments)[:, :n_codes].astype(jnp.uint16)
 
     return full
 

@@ -183,15 +183,40 @@ def _split_rpm_evr(v: str):
     return epoch, v, release
 
 
+def _unq(x: str) -> str:
+    # unquote only when an escape is present: the common purl has
+    # none, and the function-call + scan cost shows up at 10k-SBOM
+    # decode scale
+    return unquote(x) if "%" in x else x
+
+
 def from_string(s: str) -> PackageURL:
     """Parse `pkg:type/namespace/name@version?quals#subpath`."""
     if not s.startswith("pkg:"):
         raise ValueError(f"purl must start with 'pkg:': {s!r}")
+    if "%" not in s and "?" not in s and "#" not in s:
+        # fast path for the overwhelmingly common shape — no
+        # escapes, qualifiers, or subpath (exact same semantics as
+        # the general parse below, minus the unquote calls)
+        rest = s[4:].lstrip("/")
+        head, at, tail = rest.rpartition("@")
+        if at and "/" not in tail:
+            rest, version = head, tail
+        else:
+            version = ""
+        segs = rest.split("/")
+        if len(segs) < 2 or not segs[-1]:
+            raise ValueError(f"purl is missing a name: {s!r}")
+        return PackageURL(
+            type=segs[0].lower(),
+            namespace="/".join(segs[1:-1]) if len(segs) > 2 else "",
+            name=segs[-1], version=version, qualifiers=[],
+            subpath="")
     rest = s[4:].lstrip("/")
     subpath = ""
     if "#" in rest:
         rest, subpath = rest.split("#", 1)
-        subpath = unquote(subpath)
+        subpath = _unq(subpath)
     qualifiers = []
     if "?" in rest:
         rest, qs = rest.split("?", 1)
@@ -199,20 +224,23 @@ def from_string(s: str) -> PackageURL:
             if not pair:
                 continue
             k, _, v = pair.partition("=")
-            qualifiers.append((k.lower(), unquote(v)))
+            qualifiers.append((k.lower(), _unq(v)))
     version = ""
     if "@" in rest:
         # '@' in scoped npm namespaces is %40-encoded, so the first raw
         # '@' after the last '/' is the version separator.
         head, _, tail = rest.rpartition("@")
         if "/" not in tail:
-            rest, version = head, unquote(tail)
+            rest, version = head, _unq(tail)
     segs = rest.split("/")
     ptype = segs[0].lower()
     if len(segs) < 2 or not segs[-1]:
         raise ValueError(f"purl is missing a name: {s!r}")
-    name = unquote(segs[-1])
-    namespace = "/".join(unquote(x) for x in segs[1:-1])
+    name = _unq(segs[-1])
+    if len(segs) == 2:
+        namespace = ""
+    else:
+        namespace = "/".join(_unq(x) for x in segs[1:-1])
     return PackageURL(type=ptype, namespace=namespace, name=name,
                       version=version, qualifiers=qualifiers,
                       subpath=subpath)

@@ -77,6 +77,12 @@ class BatchScanRunner:
 
     def scan_images(self, images: list,
                     options: Optional[ScanOptions] = None) -> list:
+        from ..utils import defer_gc
+        with defer_gc():
+            return self._scan_images(images, options)
+
+    def _scan_images(self, images: list,
+                     options: Optional[ScanOptions] = None) -> list:
         import time as _time
         options = options or ScanOptions(backend=self.backend)
         scan_secrets = "secret" in options.security_checks
@@ -142,11 +148,13 @@ class BatchScanRunner:
             # re-merge EVERY artifact: a patched blob may be shared
             # with artifacts whose own `collected` is empty (fleets
             # share layers — the cached-layer case), and their
-            # prepare() ran before the patch landed
-            for a, p in zip(artifacts, prepared):
-                blobs = [self.cache.get_blob(b)
-                         for b in a.reference.blob_ids]
-                p.detail.secrets = merge_layer_secrets(blobs)
+            # prepare() ran before the patch landed. Nothing found →
+            # nothing patched → prepare()'s merge already stands.
+            if found:
+                for a, p in zip(artifacts, prepared):
+                    blobs = [self.cache.get_blob(b)
+                             for b in a.reference.blob_ids]
+                    p.detail.secrets = merge_layer_secrets(blobs)
         secret_s += _time.perf_counter() - t0
 
         from ..detect import batch as detect_batch
@@ -193,6 +201,12 @@ class BatchScanRunner:
         walking, no analyzers: decode → name-join → ONE interval
         dispatch for the whole fleet against the resident advisory
         tables."""
+        from ..utils import defer_gc
+        with defer_gc():
+            return self._scan_boms(boms, options)
+
+    def _scan_boms(self, boms: list,
+                   options: Optional[ScanOptions] = None) -> list:
         import time as _time
 
         from ..artifact.sbom import decode_to_blob

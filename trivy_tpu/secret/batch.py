@@ -71,7 +71,6 @@ class BatchSecretScanner:
         self.seg_len = max(seg_len, 4 * self.overlap, 128)
         self.seg_len = ((self.seg_len + 127) // 128) * 128
         self.stats: dict = {}
-        self._device_s = 0.0
 
     # --- segmenting ---
 
@@ -176,7 +175,7 @@ class BatchSecretScanner:
             "rules_wholefile": wholefile,
             "files_with_findings": len(results),
             "sieve_s": round(sieve_s, 4),
-            "device_s": round(self._device_s, 4),
+            "device_s": round(handle["device_s"], 4),
             "verify_s": round(verify_s, 4),
         }
         return results
@@ -188,9 +187,8 @@ class BatchSecretScanner:
         consumes; on the fused path the jax arrays inside are NOT yet
         materialized — the device computes in the background."""
         import time as _time
-        self._device_s = 0.0
         buf, seg_file, seg_pos = self._segment(entries)
-        handle = {"entries": entries, "buf": buf,
+        handle = {"entries": entries, "buf": buf, "device_s": 0.0,
                   "seg_file": seg_file, "seg_pos": seg_pos}
         if buf.shape[0] == 0:
             handle["mode"] = "empty"
@@ -201,7 +199,7 @@ class BatchSecretScanner:
                 buf, self.plan.table, backend=self.backend,
                 mesh=self.mesh)
             handle["mode"] = "host"
-            self._device_s += _time.perf_counter() - t0
+            handle["device_s"] += _time.perf_counter() - t0
             return handle
         # fused path: the segment buffer crosses the tunnel ONCE,
         # blockmask + run hits come out of a single dispatch on the
@@ -218,7 +216,7 @@ class BatchSecretScanner:
         nhit, idx, cm, h = make_fused_sieve(*key)(dev)
         handle.update(mode="fused", key=key, dev=dev, nhit=nhit,
                       idx=idx, cm=cm, h=h)
-        self._device_s += _time.perf_counter() - t0
+        handle["device_s"] += _time.perf_counter() - t0
         return handle
 
     def _decode(self, handle: dict) -> dict:
@@ -245,14 +243,17 @@ class BatchSecretScanner:
             K = self.plan.table.n_codes
             nhit = int(handle["nhit"])
             cm = handle["cm"]
+            h = handle["h"]
             if nhit > min(cm.shape[0], handle["dev"].shape[0]):
+                # fetch the full mask array; run hits (h) were
+                # already computed by the fused dispatch
                 from ..ops.keywords import make_full_sieve
-                m, h = make_full_sieve(*handle["key"])(handle["dev"])
+                literals, _specs, platform = handle["key"]
+                m = make_full_sieve(literals, platform)(handle["dev"])
                 masks = np.asarray(m)[:B, :K]
                 seg_nz, code_nz = np.nonzero(masks)
                 hit_vals = masks[seg_nz, code_nz]
             else:
-                h = handle["h"]
                 rows = np.asarray(cm)[:nhit, :K]
                 ridx = np.asarray(handle["idx"])[:nhit]
                 rnz, code_nz = np.nonzero(rows)
@@ -260,7 +261,7 @@ class BatchSecretScanner:
                 seg_nz = ridx[rnz]
                 hit_vals = rows[rnz, code_nz]
             run_fetch = np.asarray(h)[:B]
-        self._device_s += _time.perf_counter() - t0
+        handle["device_s"] += _time.perf_counter() - t0
 
         # run-hits decode is lazy: it happens at most once per batch,
         # and only when a run-gated rule survives its keyword gate
@@ -275,7 +276,7 @@ class BatchSecretScanner:
                             seg_file[int(si)], set()).add(int(sp))
                 else:
                     runs_cache.update(
-                        self._file_runs(buf, seg_file))
+                        self._file_runs(buf, seg_file, handle))
                 runs_ready[0] = True
             return runs_cache.get(fidx, set())
 
@@ -332,7 +333,8 @@ class BatchSecretScanner:
                 out[fidx] = chosen
         return out
 
-    def _file_runs(self, buf: np.ndarray, seg_file: list) -> dict:
+    def _file_runs(self, buf: np.ndarray, seg_file: list,
+                   handle: dict) -> dict:
         """file index → set of run-spec indices present somewhere in
         the file. One elementwise dispatch over the same segment
         buffer the sieve used; overlap ≥ max runlen keeps it sound."""
@@ -349,7 +351,7 @@ class BatchSecretScanner:
             B = buf.shape[0]
             hits = np.asarray(
                 make_run_hits(specs)(pad_batch(buf)))[:B]
-        self._device_s += _time.perf_counter() - t0
+        handle["device_s"] += _time.perf_counter() - t0
         out: dict = {}
         for si, sp in zip(*np.nonzero(hits)):
             out.setdefault(seg_file[int(si)], set()).add(int(sp))

@@ -53,17 +53,26 @@ def write_image_tar(path: str, layers: list, repo_tag: str) -> str:
 APK_PARAGRAPH = "P:{name}\nV:{version}\no:{name}\nL:MIT\n\n"
 
 
-def tiny_fleet(tmpdir: str, n_images: int = 4) -> tuple:
+def tiny_fleet(tmpdir: str, n_images: int = 4,
+               n_advisories: int = 8) -> tuple:
     """A minimal alpine-style fleet + matching advisory store: every
     image carries an apk database (half the packages vulnerable) and
-    one config file with a planted AWS key. Returns (paths, store)."""
+    one config file with a planted AWS key. Returns (paths, store).
+
+    ``n_advisories`` ≥ 8 pads the store with additional advisories
+    for packages the fleet does not install (two buckets), so the
+    compiled interval tables are a few hundred rows instead of a toy
+    8 — the multichip dryrun artifact uses this."""
     from ..db import AdvisoryStore
 
     store = AdvisoryStore()
-    for i in range(8):
+    for i in range(max(8, n_advisories)):
+        bucket = "alpine 3.16" if i % 3 else "npm::Node.js"
+        if i < 8:
+            bucket = "alpine 3.16"
         store.put_advisory(
-            "alpine 3.16", f"pkg{i}", f"CVE-2022-{10000 + i}",
-            {"FixedVersion": f"1.{i}.5-r0"})
+            bucket, f"pkg{i}", f"CVE-2022-{10000 + i}",
+            {"FixedVersion": f"1.{i % 90}.5-r0"})
         store.put_vulnerability(
             f"CVE-2022-{10000 + i}",
             {"Severity": "HIGH", "VendorSeverity": {"nvd": 3},
