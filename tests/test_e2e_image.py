@@ -143,7 +143,7 @@ class TestImageScan:
         assert report["ArtifactType"] == "container_image"
         assert report["Metadata"]["OS"] == {"Family": "alpine",
                                             "Name": "3.9.4",
-                                            "Eosl": True}
+                                            "EOSL": True}
         by_class = {r["Class"]: r for r in report["Results"]}
         vulns = by_class["os-pkgs"]["Vulnerabilities"]
         ids = {(v["PkgName"], v["VulnerabilityID"]) for v in vulns}

@@ -16,7 +16,8 @@ from .common import Code, Layer, asdict_omitempty, jfield
 class OS:
     family: str = jfield("Family", default="")
     name: str = jfield("Name", default="")
-    eosl: bool = jfield("Eosl", default=False)
+    # ref fanal/types/artifact.go:12 — tag is EOSL, not Eosl
+    eosl: bool = jfield("EOSL", default=False)
     extended: bool = jfield("Extended", default=False)
 
     def to_dict(self) -> dict:

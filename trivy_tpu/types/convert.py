@@ -33,7 +33,7 @@ def os_from_dict(x: Optional[dict]) -> Optional[OS]:
     if not x:
         return None
     return OS(family=x.get("Family", ""), name=x.get("Name", ""),
-              eosl=x.get("Eosl", False),
+              eosl=x.get("EOSL", x.get("Eosl", False)),
               extended=x.get("Extended", False))
 
 

@@ -27,18 +27,28 @@ def layer_tar_bytes(files: dict) -> bytes:
     return buf.getvalue()
 
 
-def write_image_tar(path: str, layers: list, repo_tag: str) -> str:
-    """Write a docker-save image tar with the given layer file dicts."""
+def write_image_tar(path: str, layers: list, repo_tag: str = "",
+                    config: dict = None, gzipped: bool = False) -> str:
+    """Write a docker-save image tar with the given layer file dicts.
+
+    ``config`` overrides the synthetic image config (its rootfs is
+    rewritten to the actual layer diff_ids); ``gzipped`` writes the
+    whole archive as .tar.gz — the golden-parity image fixtures use
+    both to mirror the reference's canned tarballs."""
     blobs = [layer_tar_bytes(f) for f in layers]
     diff_ids = ["sha256:" + hashlib.sha256(b).hexdigest()
                 for b in blobs]
-    config = {"architecture": "amd64", "os": "linux",
-              "rootfs": {"type": "layers", "diff_ids": diff_ids},
-              "config": {}}
+    if config is None:
+        config = {"architecture": "amd64", "os": "linux",
+                  "config": {}}
+    config = dict(config)
+    config["rootfs"] = {"type": "layers", "diff_ids": diff_ids}
     manifest = [{"Config": "config.json",
-                 "RepoTags": [repo_tag],
                  "Layers": [f"l{i}.tar" for i in range(len(blobs))]}]
-    with tarfile.open(path, "w") as tf:
+    if repo_tag:
+        manifest[0]["RepoTags"] = [repo_tag]
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
         def add(name, data):
             ti = tarfile.TarInfo(name)
             ti.size = len(data)
@@ -47,6 +57,12 @@ def write_image_tar(path: str, layers: list, repo_tag: str) -> str:
         add("manifest.json", json.dumps(manifest).encode())
         for i, b in enumerate(blobs):
             add(f"l{i}.tar", b)
+    data = buf.getvalue()
+    if gzipped:
+        import gzip
+        data = gzip.compress(data)
+    with open(path, "wb") as f:
+        f.write(data)
     return path
 
 
