@@ -238,3 +238,130 @@ class TestAWS:
             "aws", "--account-state", str(state),
             "--cache-dir", str(tmp_path / "c")])
         assert code == 1
+
+
+# Round-5 breadth: defsec's CIS-ish core over the account-state
+# evaluator (ref pkg/cloud/aws/scanner/scanner.go:28; check
+# semantics per defsec slug named in each check's docstring).
+BREADTH_STATE = {
+    "ec2": {"securityGroups": [],
+            "volumes": [
+                {"id": "vol-plain", "encryption": {"enabled": False}},
+                {"id": "vol-enc", "encryption": {"enabled": True}}]},
+    "rds": {"instances": [
+        {"id": "db-bad", "encryption": {"enabled": False},
+         "publiclyAccessible": True,
+         "backupRetentionPeriodDays": 0},
+        {"id": "db-good", "encryption": {"enabled": True},
+         "publiclyAccessible": False,
+         "backupRetentionPeriodDays": 7}]},
+    "efs": {"fileSystems": [{"id": "fs-1", "encrypted": False}]},
+    "ecr": {"repositories": [
+        {"name": "app", "imageScanning": {"scanOnPush": False},
+         "imageTagsImmutable": False},
+        {"name": "base", "imageScanning": {"scanOnPush": True},
+         "imageTagsImmutable": True}]},
+    "eks": {"clusters": [
+        {"name": "prod",
+         "publicAccess": {"enabled": True, "cidrs": ["0.0.0.0/0"]},
+         "encryption": {"secrets": False},
+         "logging": {"api": True, "audit": True,
+                     "authenticator": True,
+                     "controllerManager": True, "scheduler": True}},
+        {"name": "internal",
+         "publicAccess": {"enabled": True,
+                          "cidrs": ["10.0.0.0/8"]},
+         "encryption": {"secrets": True, "kmsKeyId": "key-1"},
+         "logging": {"api": True}}]},
+    "elb": {"loadBalancers": [
+        {"name": "web", "type": "application",
+         "dropInvalidHeaderFields": False,
+         "listeners": [
+             {"protocol": "HTTP", "defaultActionType": "forward"},
+             {"protocol": "HTTPS"}]},
+        {"name": "redirector", "type": "application",
+         "dropInvalidHeaderFields": True,
+         "listeners": [
+             {"protocol": "HTTP",
+              "defaultActionType": "redirect"}]}]},
+    "iam": {"users": [
+        {"name": "stale", "accessKeys": [
+            {"active": True,
+             "creationDate": "2020-01-01T00:00:00Z"}]},
+        {"name": "fresh", "accessKeys": [
+            {"active": True,
+             "creationDate": "2999-01-01T00:00:00Z"}]}],
+        "passwordPolicy": {"minimumLength": 8}},
+    "kms": {"keys": [
+        {"id": "cmk-1", "rotationEnabled": False},
+        {"id": "sign-key", "usage": "SIGN_VERIFY",
+         "rotationEnabled": False}]},
+    "cloudtrail": {"trails": [
+        {"name": "main", "isLogging": True,
+         "enableLogFileValidation": False, "kmsKeyId": ""}]},
+}
+
+
+class TestAWSBreadth:
+    def _fails(self, service):
+        from trivy_tpu.cloud import scan_account
+        results = scan_account(BREADTH_STATE, services=[service])
+        fails = {}
+        for r in results:
+            for m in r.misconfigurations:
+                if m.status == "FAIL":
+                    fails.setdefault(m.id, []).append(
+                        m.cause_metadata.resource
+                        if m.cause_metadata else "")
+        return fails
+
+    def test_service_inventory(self):
+        from trivy_tpu.cloud import AWS_POLICIES, KNOWN_SERVICES
+        assert len(AWS_POLICIES) >= 20
+        assert len(KNOWN_SERVICES) >= 9
+
+    def test_ebs_encryption(self):
+        assert self._fails("ec2").get("AWS-0026") == ["vol-plain"]
+
+    def test_rds(self):
+        fails = self._fails("rds")
+        assert fails["AWS-0080"] == ["db-bad"]
+        assert fails["AWS-0082"] == ["db-bad"]
+        assert fails["AWS-0077"] == ["db-bad"]
+
+    def test_efs(self):
+        assert self._fails("efs")["AWS-0037"] == ["fs-1"]
+
+    def test_ecr(self):
+        fails = self._fails("ecr")
+        assert fails["AWS-0030"] == ["app"]
+        assert fails["AWS-0031"] == ["app"]
+
+    def test_eks(self):
+        fails = self._fails("eks")
+        # 0040 fails ANY enabled public endpoint (defsec semantics);
+        # 0041 only the ones whose CIDRs include the internet
+        assert fails["AWS-0040"] == ["prod", "internal"]
+        assert fails["AWS-0041"] == ["prod"]
+        assert fails["AWS-0039"] == ["prod"]
+        assert fails["AWS-0038"] == ["internal"]
+
+    def test_elb(self):
+        fails = self._fails("elb")
+        # redirecting HTTP listener is compliant
+        assert fails["AWS-0054"] == ["web"]
+        assert fails["AWS-0052"] == ["web"]
+
+    def test_iam_password_and_rotation(self):
+        fails = self._fails("iam")
+        assert "AWS-0063" in fails           # weak password policy
+        assert fails["AWS-0146"] == ["stale"]
+
+    def test_kms(self):
+        # rotation applies to ENCRYPT_DECRYPT CMKs only
+        assert self._fails("kms")["AWS-0065"] == ["cmk-1"]
+
+    def test_cloudtrail_validation_and_cmk(self):
+        fails = self._fails("cloudtrail")
+        assert fails["AWS-0016"] == ["main"]
+        assert fails["AWS-0015"] == ["main"]
