@@ -217,3 +217,84 @@ class TestEndToEnd:
         assert not any(
             lic for r in report.get("Results") or []
             for lic in r.get("Licenses") or [])
+
+
+class TestCorpusMatching:
+    """N-gram containment against the embedded corpus (ref
+    pkg/licensing/classifier.go:42 wraps google/licenseclassifier,
+    which survives reflowed/re-indented bodies; the phrase
+    fast-path alone does not)."""
+
+    def _reflow(self, name, width=41, indent="  "):
+        import textwrap
+        from trivy_tpu.licensing.corpus import _CORPUS_TEXTS
+        body = " ".join(_CORPUS_TEXTS[name])
+        doc = ("Copyright (c) 2017 Example Industries, Inc.\n\n"
+               + body)
+        return "\n".join(indent + line
+                         for line in textwrap.wrap(doc, width))
+
+    def _names(self, text):
+        from trivy_tpu.licensing.classifier import classify_findings
+        return {(f.name, f.confidence)
+                for f in classify_findings(text.encode())}
+
+    def test_reflowed_mit(self):
+        found = self._names(self._reflow("MIT"))
+        assert ("MIT", 1.0) in found
+
+    def test_reflowed_apache(self):
+        found = self._names(self._reflow("Apache-2.0", width=33))
+        assert any(n == "Apache-2.0" and c >= 0.9
+                   for n, c in found)
+
+    def test_bsd3_not_reported_as_bsd2(self):
+        # BSD-2's corpus is a textual subset of BSD-3's; subset
+        # suppression must keep only the more specific match
+        found = self._names(self._reflow("BSD-3-Clause"))
+        names = {n for n, _ in found}
+        assert "BSD-3-Clause" in names
+        assert "BSD-2-Clause" not in names
+
+    def test_bsd2_alone(self):
+        names = {n for n, _ in
+                 self._names(self._reflow("BSD-2-Clause"))}
+        assert "BSD-2-Clause" in names
+        assert "BSD-3-Clause" not in names
+
+    def test_isc_vs_0bsd(self):
+        # ISC = 0BSD + notice-retention condition
+        assert {n for n, _ in self._names(self._reflow("ISC"))} \
+            == {"ISC"}
+        assert {n for n, _ in self._names(self._reflow("0BSD"))} \
+            == {"0BSD"}
+
+    def test_partial_text_below_threshold(self):
+        # half the MIT body missing -> containment < 0.9 -> no match
+        text = self._reflow("MIT")
+        truncated = text[: len(text) // 2]
+        assert not any(n == "MIT"
+                       for n, _ in self._names(truncated))
+
+    def test_prose_no_match(self):
+        prose = ("This project scans container images. Install "
+                 "with pip and use the software as you see fit. "
+                 "No warranty of fitness is given here. ") * 30
+        assert self._names(prose) == set()
+
+    def test_spdx_tag_still_wins(self):
+        text = ("# SPDX-License-Identifier: MIT\n"
+                + self._reflow("MIT"))
+        found = self._names(text)
+        assert ("MIT", 1.0) in found
+        assert len([n for n, _ in found if n == "MIT"]) == 1
+
+    def test_bsd3_with_org_name_variant(self):
+        # real-world clause 3 substitutes an org name for "the
+        # copyright holder"; specificity must still beat the
+        # perfect-scoring BSD-2 subset
+        text = self._reflow("BSD-3-Clause").replace(
+            "the copyright holder nor", "Google Inc. nor")
+        names = {n for n, _ in self._names(text)}
+        assert "BSD-3-Clause" in names
+        assert "BSD-2-Clause" not in names

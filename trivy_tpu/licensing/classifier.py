@@ -5,13 +5,16 @@ which ships a corpus of full license texts. This re-design detects
 licenses from three signals, strongest first:
 
 1. an explicit ``SPDX-License-Identifier:`` tag (confidence 1.0),
-2. a distinctive full-text phrase unique to one license,
-3. the license's canonical title line.
+2. n-gram containment against the embedded corpus of license
+   cores (corpus.py) — catches reflowed/re-indented bodies,
+3. a distinctive full-text phrase unique to one license,
+4. the license's canonical title line.
 
 That covers the common case — LICENSE/COPYING files and source
 headers for the licenses that dominate real software — without the
 megabyte corpus. Confidence reflects the signal: 1.0 for SPDX tags,
-0.9 for distinctive phrases, 0.8 for title matches.
+the containment fraction (>= 0.9) for corpus matches, 0.9 for
+distinctive phrases, 0.8 for title matches.
 """
 
 from __future__ import annotations
@@ -111,6 +114,17 @@ def classify_findings(content: bytes) -> list:
                 findings.append(LicenseFinding(
                     name=name, confidence=1.0,
                     link=_AVD_LINK.format(name)))
+
+    from .corpus import corpus_matches
+    for name, confidence in corpus_matches(text):
+        family = _FAMILY.get(name, name)
+        if name in seen or family in families:
+            continue
+        seen.add(name)
+        families.add(family)
+        findings.append(LicenseFinding(
+            name=name, confidence=confidence,
+            link=_AVD_LINK.format(name)))
 
     lowered = text.lower()
     for name, phrase in _PHRASES:
