@@ -365,3 +365,14 @@ class TestAWSBreadth:
         fails = self._fails("cloudtrail")
         assert fails["AWS-0016"] == ["main"]
         assert fails["AWS-0015"] == ["main"]
+
+    def test_iam_no_password_policy_fails(self):
+        # NoSuchEntity (no policy configured) is the insecure
+        # default — defsec FAILs it, never PASS
+        from trivy_tpu.cloud import scan_account
+        results = scan_account({"iam": {"users": []}},
+                               services=["iam"])
+        fails = {m.id for r in results
+                 for m in r.misconfigurations
+                 if m.status == "FAIL"}
+        assert "AWS-0063" in fails

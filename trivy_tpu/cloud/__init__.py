@@ -287,10 +287,9 @@ def _iam_password_policy(state: dict) -> list:
     """defsec aws-iam-set-minimum-password-length (and the
     companion reuse-prevention / max-age checks the reference
     groups as the password-policy family)."""
-    iam = state.get("iam") or {}
-    if "passwordPolicy" not in iam:
-        return []
-    pol = iam.get("passwordPolicy") or {}
+    # a missing passwordPolicy export is AWS's NoSuchEntity — no
+    # policy configured at all, the insecure default defsec FAILs
+    pol = (state.get("iam") or {}).get("passwordPolicy") or {}
     causes = []
     if (pol.get("minimumLength") or 0) < 14:
         causes.append(Cause(
@@ -384,10 +383,11 @@ AWS_POLICIES = [
             "MEDIUM", _cloudtrail_enabled,
             "Enable at least one logging trail"),
     _policy("AWS-0016", "cloudtrail", "CloudTrail log file "
-            "validation disabled", "LOW", _cloudtrail_log_validation,
+            "validation disabled", "HIGH",
+            _cloudtrail_log_validation,
             "Turn on log file validation for every trail"),
     _policy("AWS-0015", "cloudtrail", "CloudTrail not encrypted "
-            "with a customer-managed key", "LOW", _cloudtrail_cmk,
+            "with a customer-managed key", "HIGH", _cloudtrail_cmk,
             "Set a KMS key id on the trail"),
     _policy("AWS-0026", "ec2", "EBS volume is unencrypted", "HIGH",
             _ebs_volume_encryption,
