@@ -229,3 +229,121 @@ class TestBatchKernel:
                         vulnerable=[""], patched=[], unaffected=[],
                         payload="forced")]
         assert detect_pairs(jobs, backend="cpu-ref") == ["forced"]
+
+
+class TestRedHatContentSets:
+    """Content-set narrowing (ref redhat.go:27-44,129-138): an
+    advisory listing content sets only matches packages whose
+    buildinfo sets (or NVR, or the per-major defaults) intersect."""
+
+    def _store(self):
+        from trivy_tpu.db.store import AdvisoryStore
+        s = AdvisoryStore()
+        s.put_advisory("Red Hat", "openssl", "CVE-2099-0001", {
+            "FixedVersion": "1:1.1.1k-7.el8_6",
+            "ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]})
+        s.put_advisory("Red Hat", "openssl", "CVE-2099-0002", {
+            "FixedVersion": "1:1.1.1k-8.el8_6",
+            "ContentSets": ["rhel-8-for-s390x-baseos-rpms"]})
+        s.put_advisory("Red Hat", "openssl", "CVE-2099-0003", {
+            "FixedVersion": "1:1.1.1k-9.el8_6"})   # no sets: global
+        return s
+
+    def _pkg(self, build_info=None):
+        return Package(name="openssl", version="1.1.1k", release="6.el8",
+                       epoch=1, arch="x86_64", src_name="openssl",
+                       src_version="1.1.1k", src_release="6.el8",
+                       src_epoch=1, build_info=build_info)
+
+    def _ids(self, pkg, os_ver="8.6"):
+        vulns, _ = ospkg_detect("redhat", os_ver, None, [pkg],
+                                self._store())
+        return {v.vulnerability_id for v in vulns}
+
+    def test_buildinfo_narrows(self):
+        pkg = self._pkg({"ContentSets":
+                         ["rhel-8-for-x86_64-baseos-rpms"]})
+        # the s390x-only advisory is suppressed
+        assert self._ids(pkg) == {"CVE-2099-0001", "CVE-2099-0003"}
+
+    def test_out_of_set_all_suppressed(self):
+        pkg = self._pkg({"ContentSets":
+                         ["rhel-8-for-aarch64-baseos-rpms"]})
+        assert self._ids(pkg) == {"CVE-2099-0003"}
+
+    def test_default_content_sets_fallback(self):
+        # no buildinfo (plain RHEL host) -> defaults for major 8
+        assert self._ids(self._pkg()) == \
+            {"CVE-2099-0001", "CVE-2099-0003"}
+
+    def test_nvr_match(self):
+        s = self._store()
+        s.put_advisory("Red Hat", "openssl", "CVE-2099-0004", {
+            "FixedVersion": "1:1.1.1k-10.el8_6",
+            "ContentSets": ["ubi8-container-8.6-100-x86_64"]})
+        pkg = self._pkg({"ContentSets": [],
+                         "Nvr": "ubi8-container-8.6-100",
+                         "Arch": "x86_64"})
+        vulns, _ = ospkg_detect("redhat", "8.6", None, [pkg], s)
+        ids = {v.vulnerability_id for v in vulns}
+        assert "CVE-2099-0004" in ids
+        assert "CVE-2099-0001" not in ids
+
+
+class TestBuildInfoPipeline:
+    def test_content_manifest_analyzer(self):
+        import json
+        from trivy_tpu.analyzer.buildinfo import \
+            ContentManifestAnalyzer
+        a = ContentManifestAnalyzer()
+        path = "root/buildinfo/content_manifests/ubi8.json"
+        assert a.required(path)
+        assert not a.required("etc/content_manifests/x.json")
+        res = a.analyze(path, json.dumps(
+            {"content_sets": ["rhel-8-for-x86_64-baseos-rpms"]}
+        ).encode())
+        assert res.build_info == {
+            "ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]}
+
+    def test_dockerfile_analyzer(self):
+        from trivy_tpu.analyzer.buildinfo import \
+            BuildInfoDockerfileAnalyzer
+        a = BuildInfoDockerfileAnalyzer()
+        path = "root/buildinfo/Dockerfile-ubi8-8.6-100"
+        assert a.required(path)
+        content = (b'FROM scratch\n'
+                   b'ENV COMP=ubi8-container\n'
+                   b'LABEL com.redhat.component="$COMP" '
+                   b'architecture="x86_64"\n')
+        res = a.analyze(path, content)
+        assert res.build_info == {"Nvr": "ubi8-container-8.6-100",
+                                  "Arch": "x86_64"}
+
+    def test_applier_shares_buildinfo(self):
+        from trivy_tpu.applier import apply_layers
+        from trivy_tpu.types import BlobInfo, PackageInfo
+        base = BlobInfo(
+            diff_id="sha256:base",
+            package_infos=[PackageInfo(
+                file_path="var/lib/rpm/Packages",
+                packages=[Package(name="openssl",
+                                  version="1.1.1k")])])
+        redhat_layer = BlobInfo(
+            diff_id="sha256:rh",
+            build_info={"ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]})
+        customer = BlobInfo(
+            diff_id="sha256:cust",
+            package_infos=[PackageInfo(
+                file_path="var/lib/rpm/Packages",
+                packages=[Package(name="openssl",
+                                  version="1.1.1k"),
+                          Package(name="curl",
+                                  version="7.61.1")])])
+        detail = apply_layers([base, redhat_layer, customer])
+        by_name = {p.name: p for p in detail.packages}
+        # base layer shares layer 1's record; the customer layer
+        # (no record of its own) inherits the nearest Red Hat layer
+        assert by_name["openssl"].build_info == {
+            "ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]}
+        assert by_name["curl"].build_info == {
+            "ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]}

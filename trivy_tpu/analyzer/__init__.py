@@ -24,6 +24,7 @@ from . import licensing  # noqa: F401
 from . import pkgfiles  # noqa: F401
 from . import jar  # noqa: F401
 from . import binary  # noqa: F401
+from . import buildinfo  # noqa: F401
 
 __all__ = ["Analyzer", "AnalysisResult", "AnalyzerGroup",
            "register_analyzer", "registered_analyzers"]

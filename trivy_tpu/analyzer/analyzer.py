@@ -51,12 +51,18 @@ class AnalysisResult:
     system_files: list = field(default_factory=list)
     custom_resources: list = field(default_factory=list)
     secret_candidates: list = field(default_factory=list)  # (path, data)
+    build_info: Optional[dict] = None      # Red Hat only
 
     def merge(self, other: "AnalysisResult") -> None:
         if other is None:
             return
         if other.os is not None:
             self.os = _merge_os(self.os, other.os)
+        if other.build_info:
+            # content-manifest and buildinfo-Dockerfile analyzers
+            # contribute different keys of the same record
+            self.build_info = {**(self.build_info or {}),
+                               **other.build_info}
         if other.repository is not None:
             self.repository = other.repository
         self.package_infos.extend(other.package_infos)
@@ -99,6 +105,7 @@ class AnalysisResult:
             licenses=self.licenses,
             system_files=self.system_files,
             custom_resources=self.custom_resources,
+            build_info=self.build_info,
         )
 
 

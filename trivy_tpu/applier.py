@@ -131,8 +131,10 @@ def apply_layers(layers: list) -> ArtifactDetail:
     for pkg in merged.packages:
         if single is not None:
             digest, diff_id = single.digest, single.diff_id
+            pkg.build_info = single.build_info
         else:
-            digest, diff_id = _origin_layer_pkg(pkg, layers)
+            digest, diff_id, idx = _origin_layer_pkg(pkg, real)
+            pkg.build_info = _lookup_build_info(idx, real)
         pkg.layer = Layer(digest=digest, diff_id=diff_id)
         if pkg.name in dpkg_licenses:
             pkg.licenses = dpkg_licenses[pkg.name]
@@ -151,15 +153,34 @@ def apply_layers(layers: list) -> ArtifactDetail:
 
 
 def _origin_layer_pkg(pkg, layers) -> tuple:
-    for layer in layers:
-        if layer is None:
-            continue
+    for i, layer in enumerate(layers):
         for pkg_info in layer.package_infos:
             for p in pkg_info.packages:
                 if (p.name, p.version, p.release) == \
                         (pkg.name, pkg.version, pkg.release):
-                    return layer.digest, layer.diff_id
-    return "", ""
+                    return layer.digest, layer.diff_id, i
+    return "", "", -1
+
+
+def _lookup_build_info(index: int, layers: list):
+    """Red Hat content sets from the package's origin layer
+    (docker.go:48-70 lookupBuildInfo): the layer's own record wins;
+    the base layer (index 0) shares layer 1's; customer layers on
+    top of a Red Hat image share the nearest earlier Red Hat
+    layer's. The backward scan deliberately stops before index 0
+    (docker.go:65 ``for i := index - 1; i >= 1; i--``): Red Hat
+    base layers carry no content manifest of their own in real
+    images, so index 0 is never a source."""
+    if index < 0:
+        return None
+    if layers[index].build_info is not None:
+        return layers[index].build_info
+    if index == 0:
+        return layers[1].build_info if len(layers) > 1 else None
+    for i in range(index - 1, 0, -1):
+        if layers[i].build_info is not None:
+            return layers[i].build_info
+    return None
 
 
 def _origin_layer_lib(file_path, lib, layers) -> tuple:

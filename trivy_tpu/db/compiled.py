@@ -525,7 +525,8 @@ def _adv_enc(a: Advisory) -> list:
     return [a.vulnerability_id, a.fixed_version, a.affected_version,
             a.vulnerable_versions, a.patched_versions,
             a.unaffected_versions, a.arches, a.severity, a.vendor_ids,
-            [ds.id, ds.name, ds.url] if ds is not None else None]
+            [ds.id, ds.name, ds.url] if ds is not None else None,
+            a.content_sets]
 
 
 def _adv_dec(v: list) -> Advisory:
@@ -536,7 +537,8 @@ def _adv_dec(v: list) -> Advisory:
         vulnerability_id=v[0], fixed_version=v[1],
         affected_version=v[2], vulnerable_versions=v[3],
         patched_versions=v[4], unaffected_versions=v[5],
-        arches=v[6], severity=v[7], vendor_ids=v[8], data_source=ds)
+        arches=v[6], severity=v[7], vendor_ids=v[8], data_source=ds,
+        content_sets=v[10] if len(v) > 10 else [])
 
 
 class SwappableStore:

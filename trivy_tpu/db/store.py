@@ -28,6 +28,11 @@ class Advisory:
     severity: int = 0               # per-source severity enum value
     vendor_ids: list = field(default_factory=list)
     data_source: Optional[DataSource] = None
+    # Red Hat: repositories/NVRs this advisory applies to. Empty =
+    # applies everywhere. Flattened from trivy-db redhat-oval's
+    # repository→CPE-index indirection (redhat.go:129-138) onto the
+    # advisory record itself; observable narrowing is the same.
+    content_sets: list = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, vuln_id: str, d: dict) -> "Advisory":
@@ -45,6 +50,7 @@ class Advisory:
             data_source=DataSource(
                 id=ds.get("ID", ""), name=ds.get("Name", ""),
                 url=ds.get("URL", "")) if ds else None,
+            content_sets=list(d.get("ContentSets") or []),
         )
 
 

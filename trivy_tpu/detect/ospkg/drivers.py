@@ -54,6 +54,12 @@ class Driver:
     def installed(self, pkg) -> str:
         return format_src_version(pkg)
 
+    def adv_match(self, os_ver: str, pkg, adv) -> bool:
+        """Per-driver candidate gate, applied host-side before
+        interval jobs dispatch (and in the plain detect loop).
+        Default: the per-advisory arch lists."""
+        return arch_match(pkg, adv)
+
     # --- main loop (mirrors e.g. debian.go:85-140) ---
 
     def detect(self, store, os_ver: str, repo, pkgs: list) -> list:
@@ -68,7 +74,7 @@ class Driver:
                 log.debug("installed version parse error: %s", e)
                 continue
             for adv in store.get(bucket, self.src_name(pkg)):
-                if not arch_match(pkg, adv):
+                if not self.adv_match(os_ver, pkg, adv):
                     continue
                 if not self._is_vulnerable(comparer, installed_key,
                                            adv):
@@ -279,19 +285,52 @@ def arch_match(pkg, adv) -> bool:
         pkg.arch in adv.arches
 
 
+DEFAULT_CONTENT_SETS = {
+    # redhat.go:27-44 defaultContentSets — used when the image has
+    # no root/buildinfo content manifest (plain RHEL/CentOS hosts)
+    "6": ["rhel-6-server-rpms", "rhel-6-server-extras-rpms"],
+    "7": ["rhel-7-server-rpms", "rhel-7-server-extras-rpms"],
+    "8": ["rhel-8-for-x86_64-baseos-rpms",
+          "rhel-8-for-x86_64-appstream-rpms"],
+    "9": ["rhel-9-for-x86_64-baseos-rpms",
+          "rhel-9-for-x86_64-appstream-rpms"],
+}
+
+
 class _RedHat(Driver):
     """Red Hat / CentOS (reference: pkg/detector/ospkg/redhat).
 
     Modular packages look up under their module stream namespace
-    (redhat.go:127) and per-advisory arch lists gate matches
-    (redhat.go:150-155). Remaining simplification: advisories come
-    from the flat 'Red Hat' bucket; the reference additionally
-    narrows candidates by CPE content sets from buildinfo —
-    our name-keyed store returns the superset, which the arch +
-    version comparisons then filter."""
+    (redhat.go:127), per-advisory arch lists gate matches
+    (redhat.go:150-155), and advisories carrying content-set lists
+    only match packages whose buildinfo content sets (or NVR)
+    intersect them — layered-image advisories for repositories the
+    image never enabled are suppressed (redhat.go:129-138; the
+    content sets travel from the root/buildinfo analyzers through
+    the applier onto pkg.build_info)."""
 
     def bucket(self, os_ver: str, repo) -> str:
         return "Red Hat"
+
+    def adv_match(self, os_ver: str, pkg, adv) -> bool:
+        if not arch_match(pkg, adv):
+            return False
+        if not adv.content_sets:
+            return True         # advisory applies everywhere
+        info = pkg.build_info
+        if info is None:        # plain host: per-major defaults
+            # (redhat.go:131 — only when BuildInfo is absent, not
+            # when its set list is empty)
+            info = {"ContentSets":
+                    DEFAULT_CONTENT_SETS.get(
+                        self.eol_key(os_ver), [])}
+        sets = info.get("ContentSets") or []
+        if any(s in adv.content_sets for s in sets):
+            return True
+        nvr = info.get("Nvr")
+        if nvr and info.get("Arch"):
+            return f"{nvr}-{info['Arch']}" in adv.content_sets
+        return False
 
     def src_name(self, pkg) -> str:
         name = pkg.src_name or pkg.name

@@ -17,8 +17,7 @@ from ..detect.batch import (PairJob, ResidentPairJob, detect_pairs,
 from ..detect.enrich import fill_info
 from ..detect.library import _TYPES as LIB_TYPES
 from ..detect.library import _fixed_versions, normalize_pkg_name
-from ..detect.ospkg.drivers import (DRIVERS, arch_match,
-                                    format_src_version)
+from ..detect.ospkg.drivers import DRIVERS, format_src_version
 from ..types import (OS, DetectedVulnerability, Result, ResultClass,
                      Vulnerability)
 from ..types.common import SEVERITIES
@@ -153,7 +152,8 @@ class LocalScanner:
                         for row in cdb.candidate_rows(
                                 bucket, driver.src_name(pkg)):
                             adv = cdb.rows_meta[row][2]
-                            if not arch_match(pkg, adv):
+                            if not driver.adv_match(
+                                    detail.os.name, pkg, adv):
                                 continue
                             jobs.append(ResidentPairJob(
                                 cdb=cdb, row=row,
@@ -165,7 +165,8 @@ class LocalScanner:
                         continue
                     for adv in self.store.get(bucket,
                                               driver.src_name(pkg)):
-                        if not arch_match(pkg, adv):
+                        if not driver.adv_match(detail.os.name,
+                                                pkg, adv):
                             continue
                         jobs.append(self._ospkg_job(
                             driver, pkg, installed, adv))
