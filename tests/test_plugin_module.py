@@ -157,3 +157,78 @@ class TestModule:
             "name = 'future'\napi_version = 99\n")
         from trivy_tpu.module import Manager
         assert Manager(str(mod_dir)).load() == []
+
+
+class TestModuleCommands:
+    """module install/uninstall/list (ref app.go:693
+    NewModuleCommand; install source is a local path — the
+    reference's OCI pull is the egress seam)."""
+
+    MOD = ("name='greeter'\nversion=2\napi_version=1\n"
+           "is_post_scanner=True\n"
+           "def post_scan(results):\n    return results\n")
+
+    def test_install_list_uninstall(self, tmp_path):
+        src = tmp_path / "greeter.py"
+        src.write_text(self.MOD)
+        env = {"TRIVY_MODULE_DIR": str(tmp_path / "mods")}
+        code, out = _run(["module", "install", str(src)], env=env)
+        assert code == 0 and "installed module greeter" in out
+        code, out = _run(["module", "list"], env=env)
+        assert code == 0 and "greeter\tgreeter\t2" in out
+        code, out = _run(["m", "uninstall", "greeter"], env=env)
+        assert code == 0
+        code, out = _run(["module", "list"], env=env)
+        assert code == 0 and out.strip() == ""
+
+    def test_install_rejects_bad_handshake(self, tmp_path):
+        src = tmp_path / "bad.py"
+        src.write_text("version=1\n")        # no name
+        env = {"TRIVY_MODULE_DIR": str(tmp_path / "mods")}
+        code, _ = _run(["module", "install", str(src)], env=env)
+        assert code == 1
+        assert not (tmp_path / "mods" / "bad.py").exists()
+
+    def test_uninstall_missing(self, tmp_path):
+        env = {"TRIVY_MODULE_DIR": str(tmp_path / "mods")}
+        code, _ = _run(["module", "uninstall", "ghost"], env=env)
+        assert code == 1
+
+    def test_install_exec_error_clean(self, tmp_path):
+        src = tmp_path / "boom.py"
+        src.write_text("import nonexistent_pkg_xyz\nname='x'\n")
+        env = {"TRIVY_MODULE_DIR": str(tmp_path / "mods")}
+        code, _ = _run(["module", "install", str(src)], env=env)
+        assert code == 1             # clean error, no traceback
+
+    def test_dir_install_atomic(self, tmp_path):
+        src = tmp_path / "pack"
+        src.mkdir()
+        (src / "a.py").write_text(self.MOD)
+        (src / "b.py").write_text("version=1\n")   # no name
+        env = {"TRIVY_MODULE_DIR": str(tmp_path / "mods")}
+        code, _ = _run(["module", "install", str(src)], env=env)
+        assert code == 1
+        # nothing half-installed
+        assert not (tmp_path / "mods").exists() or \
+            not list((tmp_path / "mods").iterdir())
+
+
+class TestConfigCommand:
+    """config-only scan entry point (ref app.go:533)."""
+
+    def test_config_scan(self, tmp_path):
+        (tmp_path / "Dockerfile").write_text(
+            "FROM alpine:3.9\nUSER root\n")
+        code, out = _run(["config", str(tmp_path),
+                          "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        assert "DS002" in out            # root user misconfig
+        assert "Vulnerability" not in out
+
+    def test_conf_alias_exit_code(self, tmp_path):
+        (tmp_path / "Dockerfile").write_text(
+            "FROM alpine:3.9\nUSER root\n")
+        code, _ = _run(["conf", str(tmp_path), "--exit-code", "3",
+                        "--cache-dir", str(tmp_path / "c")])
+        assert code == 3

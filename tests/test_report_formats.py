@@ -299,3 +299,43 @@ def test_title_missing_fields_dont_crash():
     for fmt in ["sarif", "cyclonedx", "spdx", "spdx-json", "github",
                 "cosign-vuln"]:
         assert _write(fmt, report)
+
+
+class TestDependencyTree:
+    """--dependency-tree reversed origin tree (ref
+    pkg/report/table/vulnerability.go:130 renderDependencyTree)."""
+
+    def _report(self):
+        from trivy_tpu.types import (DetectedVulnerability, Package,
+                                     Report, Result, Vulnerability)
+        pkgs = [
+            Package(id="app@1.0.0", name="app", version="1.0.0",
+                    depends_on=["widget-kit@2.0.0"]),
+            Package(id="widget-kit@2.0.0", name="widget-kit",
+                    version="2.0.0", depends_on=["jquery@3.4.1"]),
+            Package(id="jquery@3.4.1", name="jquery",
+                    version="3.4.1"),
+        ]
+        vuln = DetectedVulnerability(
+            vulnerability_id="CVE-2020-11022", pkg_id="jquery@3.4.1",
+            pkg_name="jquery", installed_version="3.4.1",
+            fixed_version=">=3.5.0",
+            vulnerability=Vulnerability(title="xss",
+                                        severity="MEDIUM"))
+        return Report(results=[Result(
+            target="package-lock.json", packages=pkgs,
+            vulnerabilities=[vuln])])
+
+    def test_tree_rendered(self):
+        from trivy_tpu.report.writer import render_table
+        out = render_table(self._report(), dependency_tree=True)
+        assert "Dependency Origin Tree (Reversed)" in out
+        assert "└── jquery@3.4.1, (MEDIUM: 1)" in out
+        # the chain walks parents transitively
+        assert "└── widget-kit@2.0.0" in out
+        assert "    └── app@1.0.0" in out
+
+    def test_tree_off_by_default(self):
+        from trivy_tpu.report.writer import render_table
+        out = render_table(self._report())
+        assert "Dependency Origin Tree" not in out

@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--skip-dirs", default="")
         sp.add_argument("--skip-files", default="")
         sp.add_argument("--list-all-pkgs", action="store_true")
+        sp.add_argument("--dependency-tree", action="store_true",
+                        help="show a reversed dependency origin "
+                        "tree under the vulnerability table "
+                        "(table format only)")
         sp.add_argument("--backend", default="tpu",
                         choices=["tpu", "cpu", "cpu-ref"])
         sp.add_argument("--db-fixtures", default="",
@@ -152,6 +156,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "(CycloneDX/SPDX, vuln checks only)")
     sbom.add_argument("target")
     scan_flags(sbom)
+
+    conf = sub.add_parser("config", aliases=["conf"],
+                          help="scan config files for "
+                          "misconfigurations only (ref "
+                          "app.go:533 NewConfigCommand)")
+    conf.add_argument("target")
+    scan_flags(conf)
 
     k8s = sub.add_parser(
         "k8s", help="scan kubernetes manifests/cluster state "
@@ -236,13 +247,27 @@ def build_parser() -> argparse.ArgumentParser:
     prun.add_argument("name")
     prun.add_argument("plugin_args", nargs=argparse.REMAINDER)
 
+    mod = sub.add_parser("module", aliases=["m"],
+                         help="manage extension modules (ref "
+                         "app.go:693 NewModuleCommand)")
+    modsub = mod.add_subparsers(dest="module_command")
+    mi = modsub.add_parser("install", aliases=["i"],
+                           help="install a module from a local "
+                           ".py file or a directory of them (the "
+                           "reference pulls from an OCI repo; the "
+                           "registry fetch is the egress seam)")
+    mi.add_argument("source")
+    mu = modsub.add_parser("uninstall", aliases=["u"])
+    mu.add_argument("name")
+    modsub.add_parser("list")
+
     sub.add_parser("version", help="print version")
     return p
 
 
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
                    "sbom", "k8s", "aws", "db", "server", "plugin",
-                   "version")
+                   "config", "conf", "module", "m", "version")
 
 
 def main(argv=None) -> int:
@@ -313,13 +338,21 @@ def _dispatch(args) -> int:
         print(f"trivy-tpu {__version__}")
         return 0
     if args.command in ("image", "filesystem", "fs", "rootfs",
-                        "repo", "sbom", "k8s"):
+                        "repo", "sbom", "k8s", "config", "conf"):
         from .module import Manager as _ModuleManager
         _ModuleManager().load()
     if args.command in ("image",):
         return run_image(args)
     if args.command in ("filesystem", "fs", "rootfs"):
         return run_fs(args)
+    if args.command in ("config", "conf"):
+        # misconfiguration-only entry point: the fs pipeline with
+        # the scanners pinned to config (ref app.go:533)
+        args.security_checks = "config"
+        args.vuln_type = ""
+        return run_fs(args)
+    if args.command in ("module", "m"):
+        return run_module(args)
     if args.command == "repo":
         return run_repo(args)
     if args.command == "sbom":
@@ -362,6 +395,35 @@ def run_aws(args) -> int:
         results=results,
     )
     return _finish(args, report)
+
+
+def run_module(args) -> int:
+    """module install/uninstall/list (ref app.go:693)."""
+    from . import module as module_mod
+    cmd = args.module_command
+    if cmd in ("install", "i"):
+        try:
+            names = module_mod.install(args.source)
+        except (ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        for name in names:
+            print(f"installed module {name}")
+        return 0
+    if cmd in ("uninstall", "u"):
+        if not module_mod.uninstall(args.name):
+            print(f"error: no such module: {args.name}",
+                  file=sys.stderr)
+            return 1
+        print(f"uninstalled module {args.name}")
+        return 0
+    if cmd == "list":
+        for stem, name, version in module_mod.list_installed():
+            print(f"{stem}\t{name}\t{version}")
+        return 0
+    print("usage: trivy-tpu module {install,uninstall,list}",
+          file=sys.stderr)
+    return 2
 
 
 def run_plugin(args) -> int:
@@ -662,7 +724,10 @@ def _scan_options(args) -> ScanOptions:
                          args.security_checks.split(",") if c],
         # SBOM interchange formats need the full package inventory
         # (ref pkg/commands/artifact/run.go ListAllPkgs override)
+        # the tree renders from Result.Packages, so it implies the
+        # full inventory (ref report_flags.go ListAllPkgs override)
         list_all_packages=args.list_all_pkgs or
+        getattr(args, "dependency_tree", False) or
         args.format in _SBOM_FORMATS,
         backend="cpu-ref" if args.backend == "cpu-ref" else args.backend,
     )
@@ -692,7 +757,9 @@ def _finish(args, report: Report) -> int:
                      severities=[str(s) for s in
                                  _severities(args.severity)],
                      app_version=__version__,
-                     output_template=getattr(args, "template", ""))
+                     output_template=getattr(args, "template", ""),
+                     dependency_tree=getattr(
+                         args, "dependency_tree", False))
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

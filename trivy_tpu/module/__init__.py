@@ -112,20 +112,8 @@ class Manager:
         return self.modules
 
     def _load_one(self, path: str):
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-        mod = types_mod.ModuleType(
-            "trivy_module_" +
-            os.path.basename(path).removesuffix(".py"))
-        exec(compile(source, path, "exec"), mod.__dict__)
-        name = getattr(mod, "name", "")
-        api = getattr(mod, "api_version", 1)
-        if not name:
-            raise ValueError("module must set `name`")
-        if api > SUPPORTED_API_VERSION:
-            raise ValueError(
-                f"module {name} requires api_version {api} > "
-                f"{SUPPORTED_API_VERSION}")
+        mod = _exec_module(path)
+        name = mod.name
         if getattr(mod, "is_analyzer", False):
             register_analyzer(_ModuleAnalyzer(mod))
             log.info("registered module analyzer %s", name)
@@ -133,3 +121,92 @@ class Manager:
             register_post_scanner(_ModulePostScanner(mod))
             log.info("registered module post-scanner %s", name)
         return mod
+
+
+# --- management commands (ref pkg/commands/app.go:693 + pkg/module
+# Install/Uninstall; the reference pulls modules from an OCI
+# repository — the registry fetch is the documented egress seam, so
+# install here takes a local .py file or a directory of them) ---
+
+def _exec_module(path: str):
+    """Execute a module file and check the handshake: it must set
+    `name` and a supported `api_version` (module.go's export
+    validation). Shared by loading, install validation and
+    listing. Any exec-time failure surfaces as ValueError so
+    callers print one clean error."""
+    mod = types_mod.ModuleType(
+        "trivy_module_" +
+        os.path.basename(path).removesuffix(".py"))
+    try:
+        with open(path, encoding="utf-8") as f:
+            exec(compile(f.read(), path, "exec"), mod.__dict__)
+    except Exception as e:          # noqa: BLE001 — module code
+        # can fail arbitrarily; it must not traceback the CLI
+        raise ValueError(f"{path}: {e!r}") from e
+    if not getattr(mod, "name", ""):
+        raise ValueError(f"{path}: module must set `name`")
+    api = getattr(mod, "api_version", 1)
+    if api > SUPPORTED_API_VERSION:
+        raise ValueError(
+            f"{path}: module {mod.name} requires api_version "
+            f"{api} > {SUPPORTED_API_VERSION}")
+    return mod
+
+
+def install(source: str, directory: str = "") -> list:
+    """Copy module file(s) into the modules dir. Every file is
+    validated before any is copied, so a bad file in a directory
+    install leaves nothing half-installed. → installed names."""
+    import shutil
+    directory = directory or modules_dir()
+    if os.path.isfile(source):
+        files = [source]
+    elif os.path.isdir(source):
+        files = [os.path.join(source, f)
+                 for f in sorted(os.listdir(source))
+                 if f.endswith(".py") and not f.startswith("_")]
+    else:
+        raise ValueError(f"no such file or directory: {source}")
+    if not files:
+        raise ValueError(f"no module files in {source}")
+    for f in files:
+        if not f.endswith(".py"):
+            raise ValueError(f"not a Python module: {f}")
+        _exec_module(f)
+    installed = []
+    os.makedirs(directory, exist_ok=True)
+    for f in files:
+        dest = os.path.join(directory, os.path.basename(f))
+        shutil.copyfile(f, dest)
+        installed.append(
+            os.path.basename(f).removesuffix(".py"))
+    return installed
+
+
+def uninstall(name: str, directory: str = "") -> bool:
+    directory = directory or modules_dir()
+    path = os.path.join(directory, name + ".py")
+    if not os.path.isfile(path):
+        return False
+    os.remove(path)
+    return True
+
+
+def list_installed(directory: str = "") -> list:
+    """→ [(file-stem, declared name, version)] without registering
+    anything."""
+    directory = directory or modules_dir()
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        path = os.path.join(directory, fname)
+        try:
+            mod = _exec_module(path)
+            name, version = mod.name, getattr(mod, "version", 1)
+        except ValueError:
+            name, version = "<broken>", 0
+        out.append((fname.removesuffix(".py"), name, version))
+    return out

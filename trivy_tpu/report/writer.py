@@ -22,13 +22,15 @@ FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json",
 def write_report(report: Report, fmt: str = "table",
                  output=None, severities: Optional[list] = None,
                  app_version: str = "dev",
-                 output_template: str = "") -> None:
+                 output_template: str = "",
+                 dependency_tree: bool = False) -> None:
     out = output or sys.stdout
     if fmt == "json":
         json.dump(report.to_dict(), out, indent=2)
         out.write("\n")
     elif fmt == "table":
-        out.write(render_table(report, severities))
+        out.write(render_table(report, severities,
+                               dependency_tree=dependency_tree))
     elif fmt == "sarif":
         from .sarif import SarifWriter
         SarifWriter(out, version=app_version).write(report)
@@ -77,7 +79,8 @@ def write_report(report: Report, fmt: str = "table",
 
 
 def render_table(report: Report,
-                 severities: Optional[list] = None) -> str:
+                 severities: Optional[list] = None,
+                 dependency_tree: bool = False) -> str:
     sevs = [str(s) if isinstance(s, Severity) else s
             for s in (severities or _SEV_ORDER)]
     lines = []
@@ -108,6 +111,8 @@ def render_table(report: Report,
                              v.severity, v.installed_version,
                              v.fixed_version, title))
             lines.extend(_table(rows))
+            if dependency_tree:
+                lines.extend(_dependency_tree(result))
         if result.secrets:
             lines.append("")
             lines.append(header + " (secrets)")
@@ -141,6 +146,55 @@ def render_table(report: Report,
     if not lines:
         return "\n"
     return "\n".join(lines) + "\n"
+
+
+def _dependency_tree(result) -> list:
+    """Reversed dependency-origin tree under the vulnerability table
+    (ref pkg/report/table/vulnerability.go:130
+    renderDependencyTree): each vulnerable package prints once with
+    its severity tally, then the chain of packages that depend on
+    it, so the user can see which direct dependency pulled the
+    vulnerable one in."""
+    parents: dict = {}
+    for pkg in result.packages:
+        for dep in pkg.depends_on:
+            parents.setdefault(dep, []).append(pkg.id)
+    if not parents:
+        return []
+
+    sev_count: dict = {}
+    for v in result.vulnerabilities:
+        counts = sev_count.setdefault(v.pkg_id, {})
+        counts[v.severity] = counts.get(v.severity, 0) + 1
+
+    lines = ["", "Dependency Origin Tree (Reversed)",
+             "=================================", result.target]
+
+    def add(pkg_id, prefix, seen):
+        seen = seen | {pkg_id}
+        ps = [p for p in parents.get(pkg_id, []) if p not in seen]
+        for i, parent in enumerate(ps):
+            last = i == len(ps) - 1
+            lines.append(prefix + ("└── " if last else "├── ")
+                         + parent)
+            add(parent, prefix + ("    " if last else "│   "), seen)
+
+    top = []
+    seen_top = set()
+    for v in result.vulnerabilities:
+        if v.pkg_id and v.pkg_id not in seen_top:
+            seen_top.add(v.pkg_id)
+            top.append(v.pkg_id)
+    for i, pkg_id in enumerate(top):
+        counts = sev_count.get(pkg_id, {})
+        summary = ", ".join(
+            f"{s}: {counts[s]}" for s in _SEV_ORDER if s in counts)
+        last = i == len(top) - 1
+        lines.append(("└── " if last else "├── ")
+                     + f"{pkg_id}, ({summary})")
+        add(pkg_id, "    " if last else "│   ", set())
+    lines.append("")
+    return lines
 
 
 def _sev_rank(s: str) -> int:
