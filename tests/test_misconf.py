@@ -1011,3 +1011,78 @@ class TestAdvisorRound4:
         paths = {m.file_path for m in out}
         assert "Chart.yaml" not in paths
         assert "values.yaml" not in paths
+
+
+class TestEvaluationTrace:
+    """--trace evaluation visibility (rego-trace analog, ref
+    pkg/flag/rego_flags.go:21-26): Unresolved bail-outs are
+    reported so "no findings" is distinguishable from "couldn't
+    evaluate"."""
+
+    TF = b'''
+resource "aws_s3_bucket" "b" {
+  bucket = "my-bucket"
+  policy = jsonencode({foo = "bar"})
+  acl    = var.acl
+}
+variable "acl" {}
+'''
+
+    def _scan(self, trace):
+        from trivy_tpu.misconf import configure, scan_config_files
+        from trivy_tpu.types.artifact import ConfigFile
+        configure(trace=trace)
+        try:
+            return scan_config_files([ConfigFile(
+                type="terraform", file_path="main.tf",
+                content=self.TF)])
+        finally:
+            configure()
+
+    def test_trace_lines(self):
+        mcs = self._scan(trace=True)
+        assert len(mcs) == 1
+        traces = mcs[0].traces
+        assert any("policy = <unresolved: call jsonencode()>" in t
+                   for t in traces)
+        assert any("acl = <unresolved: var.acl>" in t
+                   for t in traces)
+        # traces carry file:line anchors
+        assert all(t.startswith("main.tf:") for t in traces)
+
+    def test_off_by_default(self):
+        mcs = self._scan(trace=False)
+        assert mcs[0].traces == []
+
+    def test_detected_misconf_carries_traces(self):
+        from trivy_tpu.scan.local import _to_detected_misconf
+        from trivy_tpu.types.common import Layer
+        mc = self._scan(trace=True)[0]
+        d = _to_detected_misconf(
+            (mc.failures or mc.successes)[0], "UNKNOWN", "PASS",
+            Layer(), traces=mc.traces)
+        assert d.traces == mc.traces
+        assert "Traces" in d.to_dict()
+
+    def test_trace_once_per_clean_file(self, tmp_path):
+        """An all-pass file carries the trace once (on its first
+        PASS row), not duplicated onto every policy result."""
+        import contextlib, io, json
+        from trivy_tpu.cli import main
+        (tmp_path / "main.tf").write_text(
+            'resource "aws_instance" "i" {\n'
+            '  ami = lookup(var.amis, "us-east-1")\n}\n')
+        out = tmp_path / "r.json"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main(["config", str(tmp_path), "--trace",
+                         "--include-non-failures",
+                         "--format", "json", "--output", str(out),
+                         "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        rep = json.loads(out.read_text())
+        carriers = [m for r in rep["Results"]
+                    for m in r.get("Misconfigurations", [])
+                    if m.get("Traces")]
+        assert len(carriers) == 1
+        assert any("lookup" in t for t in carriers[0]["Traces"])

@@ -194,6 +194,18 @@ class TestModuleCommands:
         code, _ = _run(["module", "uninstall", "ghost"], env=env)
         assert code == 1
 
+    def test_uninstall_rejects_traversal(self, tmp_path):
+        import pathlib
+        victim = tmp_path / "victim.py"
+        victim.write_text("x = 1\n")
+        moddir = tmp_path / "mods"
+        moddir.mkdir()
+        env = {"TRIVY_MODULE_DIR": str(moddir)}
+        rel = "../victim"
+        code, _ = _run(["module", "uninstall", rel], env=env)
+        assert code == 1
+        assert victim.exists()
+
     def test_install_exec_error_clean(self, tmp_path):
         src = tmp_path / "boom.py"
         src.write_text("import nonexistent_pkg_xyz\nname='x'\n")

@@ -106,6 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--helm-set", default="",
                         help="comma-separated helm key=value "
                         "overrides (--set analog)")
+        sp.add_argument("--trace", action="store_true",
+                        help="record misconfig evaluation traces "
+                        "in the results (the rego --trace analog): "
+                        "which attributes the HCL subset could not "
+                        "evaluate, so 'no findings' is "
+                        "distinguishable from 'couldn't evaluate'")
         sp.add_argument("--no-cache", action="store_true")
         sp.add_argument("--cache-backend", default="fs",
                         help="layer cache backend: fs | "
@@ -693,7 +699,8 @@ def _artifact_option(args) -> ArtifactOption:
                                       "").split(",") if f],
             helm_set_values=[v for v in
                              getattr(args, "helm_set",
-                                     "").split(",") if v])
+                                     "").split(",") if v],
+            trace=getattr(args, "trace", False))
     scanner = None
     if "secret" in checks:
         cpu = new_scanner(load_config(args.secret_config))
@@ -809,12 +816,18 @@ def _rpc_error():
 
 def _scanner(args, cache):
     """Local or remote scan driver — the client needs no DB when a
-    server is set (ref run.go:269-271 initDB skipped)."""
+    server is set (ref run.go:269-271 initDB skipped), and a scan
+    without vuln checks (e.g. the config command) skips advisory
+    DB loading entirely (ref app.go:533 omits DBFlagGroup)."""
     if getattr(args, "server", ""):
         from .rpc.client import RemoteScanner
         return RemoteScanner(args.server, token=args.auth_token,
                              token_header=args.token_header,
                              custom_headers=_custom_headers(args))
+    checks = [c for c in getattr(args, "security_checks",
+                                 "vuln").split(",") if c]
+    if "vuln" not in checks:
+        return LocalScanner(cache, AdvisoryStore())
     return LocalScanner(cache, _store(args))
 
 

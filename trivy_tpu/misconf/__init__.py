@@ -58,19 +58,20 @@ class MisconfOptions:
     """Engine options (reference config.ScannerOption subset)."""
 
     def __init__(self, policy_dirs=None, helm_value_files=None,
-                 helm_set_values=None):
+                 helm_set_values=None, trace=False):
         self.policy_dirs = list(policy_dirs or [])
         self.helm_value_files = list(helm_value_files or [])
         self.helm_set_values = list(helm_set_values or [])
         self.custom_policies = _load_custom(self.policy_dirs)
+        self.trace = bool(trace)
 
 
 def configure(policy_dirs=None, helm_value_files=None,
-              helm_set_values=None) -> None:
+              helm_set_values=None, trace=False) -> None:
     """Install engine options (called by the CLI before scanning)."""
     global _options
     _options = MisconfOptions(policy_dirs, helm_value_files,
-                              helm_set_values)
+                              helm_set_values, trace)
 
 
 def _load_custom(dirs: list) -> dict:
@@ -241,6 +242,16 @@ def _scan_terraform(tf_files: list) -> list:
         except Exception as e:       # noqa: BLE001 - stay robust
             log.debug("terraform parse error in %s: %s", _d, e)
             continue
+        # --trace: evaluation visibility — where the HCL subset
+        # bailed to Unresolved, grouped per source file (the rego
+        # --trace analog; checks never fail on unknowns, so these
+        # are exactly the spots "clean" might mean "couldn't
+        # evaluate")
+        trace_by_file: dict = {}
+        if _options.trace:
+            from .hcl import unresolved_trace
+            for src, line in unresolved_trace(blocks):
+                trace_by_file.setdefault(src, []).append(line)
         # evaluate once per module; split causes per source file
         per_file: dict = {cf.file_path: ([], []) for cf in files}
         for policy, custom in _policies_for("terraform"):
@@ -264,7 +275,8 @@ def _scan_terraform(tf_files: list) -> list:
                                      r.cause_metadata.start_line))
             out.append(Misconfiguration(
                 file_type="terraform", file_path=fp,
-                successes=succ, failures=fail))
+                successes=succ, failures=fail,
+                traces=trace_by_file.get(fp, [])))
     return out
 
 

@@ -419,3 +419,39 @@ def parse_module(files: dict) -> list:
             b.src_path = path
             out.append(b)
     return out
+
+
+def unresolved_trace(blocks: list) -> list:
+    """Per-module evaluation visibility (the reference's rego
+    --trace analog, pkg/flag/rego_flags.go:21-26): one line per
+    attribute whose value the HCL subset could not evaluate, so a
+    user can tell "no findings" apart from "couldn't evaluate".
+    → [(src_path, "path:line block ref: attr = <unresolved: why>")]
+    — structured so callers group by the real source path rather
+    than re-splitting the display string (paths may contain
+    colons)."""
+    lines = []
+
+    def walk_value(v, emit):
+        if isinstance(v, Unresolved):
+            emit(v.why)
+        elif isinstance(v, list):
+            for item in v:
+                walk_value(item, emit)
+        elif isinstance(v, dict):
+            for item in v.values():
+                walk_value(item, emit)
+
+    def walk_block(b, src):
+        ref = " ".join([b.type] + [f"{l!r}" for l in b.labels])
+        for name, attr in b.attrs.items():
+            walk_value(attr.value, lambda why, n=name, a=attr:
+                       lines.append((src,
+                                     f"{src}:{a.line} {ref}: {n} = "
+                                     f"<unresolved: {why}>")))
+        for nested in b.blocks:
+            walk_block(nested, src)
+
+    for b in blocks:
+        walk_block(b, getattr(b, "src_path", ""))
+    return lines

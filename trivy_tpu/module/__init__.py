@@ -184,6 +184,11 @@ def install(source: str, directory: str = "") -> list:
 
 
 def uninstall(name: str, directory: str = "") -> bool:
+    # names are bare module stems — reject separators so a crafted
+    # name cannot traverse out of the modules dir
+    if name != os.path.basename(name) or ".." in name or \
+            "/" in name or "\\" in name:
+        return False
     directory = directory or modules_dir()
     path = os.path.join(directory, name + ".py")
     if not os.path.isfile(path):

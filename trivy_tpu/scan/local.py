@@ -359,16 +359,28 @@ class LocalScanner:
             detected = []
             for f in mc.failures:
                 detected.append(_to_detected_misconf(
-                    f, "CRITICAL", "FAIL", mc.layer))
+                    f, "CRITICAL", "FAIL", mc.layer,
+                    traces=mc.traces))
             for w in mc.warnings:
                 detected.append(_to_detected_misconf(
-                    w, "MEDIUM", "FAIL", mc.layer))
+                    w, "MEDIUM", "FAIL", mc.layer,
+                    traces=mc.traces))
+            # the per-file trace rides every failure; an all-pass
+            # file carries it once on its first success — exactly
+            # the case where "clean" must be distinguishable from
+            # "couldn't evaluate" — instead of duplicating the
+            # whole list onto every PASS row
+            file_traces = mc.traces if not (
+                mc.failures or mc.warnings) else []
             for s in mc.successes:
                 detected.append(_to_detected_misconf(
-                    s, "UNKNOWN", "PASS", mc.layer))
+                    s, "UNKNOWN", "PASS", mc.layer,
+                    traces=file_traces))
+                file_traces = []
             for e in mc.exceptions:
                 detected.append(_to_detected_misconf(
-                    e, "UNKNOWN", "EXCEPTION", mc.layer))
+                    e, "UNKNOWN", "EXCEPTION", mc.layer,
+                    traces=mc.traces))
             out.append(Result(
                 target=mc.file_path,
                 class_=ResultClass.CONFIG,
@@ -431,7 +443,7 @@ class LocalScanner:
 
 
 def _to_detected_misconf(res, default_severity: str, status: str,
-                         layer):
+                         layer, traces=None):
     """toDetectedMisconfiguration (ref local/scan.go:398-452)."""
     from ..types.report import DetectedMisconfiguration
 
@@ -453,4 +465,5 @@ def _to_detected_misconf(res, default_severity: str, status: str,
         resolution=res.recommended_actions,
         severity=severity, primary_url=primary_url,
         references=references, status=status, layer=layer,
-        cause_metadata=res.cause_metadata)
+        cause_metadata=res.cause_metadata,
+        traces=list(traces or []))

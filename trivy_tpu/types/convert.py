@@ -159,6 +159,7 @@ def detected_misconfiguration_from_dict(x: dict) \
         layer=layer_from_dict(x.get("Layer")),
         cause_metadata=cause_metadata_from_dict(
             x.get("CauseMetadata")),
+        traces=x.get("Traces") or [],
     )
 
 
@@ -241,6 +242,7 @@ def misconfiguration_from_dict(x: dict):
         exceptions=[misconf_result_from_dict(r)
                     for r in x.get("Exceptions") or []],
         layer=layer_from_dict(x.get("Layer")),
+        traces=x.get("Traces") or [],
     )
 
 

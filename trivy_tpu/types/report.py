@@ -114,6 +114,8 @@ class Misconfiguration:
     failures: list = jfield("Failures", default_factory=list)
     exceptions: list = jfield("Exceptions", default_factory=list)
     layer: Layer = jfield("Layer", default_factory=Layer)
+    # --trace evaluation visibility lines (rego-trace analog)
+    traces: list = jfield("Traces", default_factory=list)
 
     def to_dict(self) -> dict:
         return asdict_omitempty(self)
