@@ -9,7 +9,9 @@ does, adapted to this runtime:
 2. daemon — a Docker/Podman socket exporting the image as a tarball
    (``docker save`` over the HTTP API; probed, clean error when no
    socket is up),
-3. registry — a ``RegistryClient`` implementing
+3. containerd — the containerd socket, exported through the ``ctr``
+   CLI into an OCI archive (CONTAINERD_ADDRESS/NAMESPACE honored),
+4. registry — a ``RegistryClient`` implementing
    ``pull(ref) -> ImageSource``; the default client reports that
    network pulls need egress. A fake client injects in tests, and a
    real distribution-API client drops into the same seam.
@@ -108,6 +110,60 @@ class DaemonClient:
             conn.close()
 
 
+class ContainerdClient:
+    """The tryContainerd leg (ref
+    pkg/fanal/image/daemon/containerd.go): containerd's socket
+    speaks gRPC, so instead of a protobuf client this exports the
+    image through the stock ``ctr images export`` CLI into an OCI
+    archive — same socket probe (CONTAINERD_ADDRESS, default
+    /run/containerd/containerd.sock), same CONTAINERD_NAMESPACE
+    default, same observable result (an archive the image loader
+    reads)."""
+
+    DEFAULT_SOCKET = "/run/containerd/containerd.sock"
+
+    def __init__(self, address: Optional[str] = None,
+                 namespace: str = ""):
+        # None = env/default probing; "" = leg disabled (the
+        # injection seam, like DaemonClient(sockets=()))
+        if address is None:
+            address = os.environ.get("CONTAINERD_ADDRESS",
+                                     self.DEFAULT_SOCKET)
+        self.address = address
+        self.namespace = namespace or os.environ.get(
+            "CONTAINERD_NAMESPACE", "default")
+
+    def available(self) -> bool:
+        return bool(self.address) and os.path.exists(self.address)
+
+    def export(self, ref: str) -> str:
+        import shutil
+        import subprocess
+        ctr = shutil.which("ctr")
+        if ctr is None:
+            raise ResolveError(
+                "containerd socket is up but the 'ctr' CLI is not "
+                "installed (needed to export the image)")
+        fd, tmp = tempfile.mkstemp(suffix=".tar",
+                                   prefix="trivy-tpu-containerd-")
+        os.close(fd)
+        cmd = [ctr, "--address", self.address,
+               "--namespace", self.namespace,
+               "images", "export", tmp, ref]
+        try:
+            proc = subprocess.run(cmd, capture_output=True,
+                                  text=True, timeout=300)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            os.unlink(tmp)
+            raise ResolveError(f"containerd export failed: {e}")
+        if proc.returncode != 0:
+            os.unlink(tmp)
+            raise ResolveError(
+                "containerd export failed: "
+                f"{proc.stderr.strip()[:300]}")
+        return tmp
+
+
 class RegistryClient:
     """The tryRemote leg: the real OCI distribution client
     (artifact/registry.py — token auth, platform select, blob
@@ -131,40 +187,57 @@ class RegistryClient:
                 f"directory)")
 
 
+def _loaded_tmp(tmp: str, ref: str, name: Optional[str])\
+        -> ImageSource:
+    """Load an exported archive whose layers are read lazily during
+    the scan — the file must outlive this call. The scan driver
+    calls src.cleanup() when done; atexit is the backstop for
+    library users who forget."""
+    src = load_image(tmp, name=name or ref)
+    src.cleanup = lambda: (os.path.exists(tmp) and os.unlink(tmp))
+    atexit.register(src.cleanup)
+    return src
+
+
 def resolve_image(ref: str, name: Optional[str] = None,
                   daemon: Optional[DaemonClient] = None,
+                  containerd: Optional[ContainerdClient] = None,
                   registry: Optional[RegistryClient] = None)\
         -> ImageSource:
-    """image.go:66-105's fallback chain."""
+    """image.go:66-105's fallback chain: tryDockerd → tryPodman →
+    tryContainerd → tryRemote."""
     # 1. local archive / layout
     if os.path.exists(ref):
         return load_image(ref, name=name)
 
-    # 2. daemon export
+    # 2. daemon export (docker + podman sockets)
     daemon = daemon or DaemonClient()
-    daemon_err = ""
+    leg_errs = []
     if daemon.available_socket():
         try:
             tmp = daemon.export(ref)
         except ResolveError as e:
-            daemon_err = str(e)
+            leg_errs.append(f"daemon: {e}")
             log.warning("daemon resolution failed: %s", e)
         else:
-            # layers read lazily from the exported tar during the
-            # scan — the file must outlive this call. The scan
-            # driver calls src.cleanup() when done; atexit is the
-            # backstop for library users who forget.
-            src = load_image(tmp, name=name or ref)
-            src.cleanup = lambda: (os.path.exists(tmp) and
-                                   os.unlink(tmp))
-            atexit.register(src.cleanup)
-            return src
+            return _loaded_tmp(tmp, ref, name)
 
-    # 3. registry pull
+    # 3. containerd export
+    containerd = containerd or ContainerdClient()
+    if containerd.available():
+        try:
+            tmp = containerd.export(ref)
+        except ResolveError as e:
+            leg_errs.append(f"containerd: {e}")
+            log.warning("containerd resolution failed: %s", e)
+        else:
+            return _loaded_tmp(tmp, ref, name)
+
+    # 4. registry pull
     registry = registry or RegistryClient()
     try:
         return registry.pull(ref)
     except ResolveError as e:
-        if daemon_err:
-            raise ResolveError(f"{e} (daemon: {daemon_err})")
+        if leg_errs:
+            raise ResolveError(f"{e} ({'; '.join(leg_errs)})")
         raise

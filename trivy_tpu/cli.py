@@ -301,11 +301,12 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
     from .artifact.redis_cache import RedisError
+    from .artifact.s3_cache import S3Error
     try:
         with scan_deadline(timeout_s), \
                 _profiled(getattr(args, "profile_dir", "")):
             return _dispatch(args)
-    except (RedisError, ValueError) as e:
+    except (RedisError, S3Error, ValueError) as e:
         # cache-backend connect/IO failures and bad backend values
         # fail cleanly, never with a traceback
         print(f"error: {e}", file=sys.stderr)
@@ -799,10 +800,14 @@ def _cache(args):
     if backend.startswith("redis://"):
         from .artifact.redis_cache import RedisCache
         return RedisCache(backend)
+    if backend.startswith("s3://"):
+        from .artifact.s3_cache import S3Cache
+        return S3Cache(backend)
     if backend != "fs":
         raise ValueError(
             f"unsupported --cache-backend {backend!r} "
-            "(use 'fs' or redis://host:port)")
+            "(use 'fs', redis://host:port, or "
+            "s3://bucket/prefix?endpoint=...)")
     from .artifact.cache import MemoryCache
     if args.no_cache:
         return MemoryCache()
