@@ -128,12 +128,25 @@ def apply_layers(layers: list) -> ArtifactDetail:
     real = [l for l in layers if l is not None]
     single = real[0] if len(real) == 1 else None
 
+    if single is None:
+        # first-layer-wins origin index: the per-package linear
+        # scan over every layer's package lists was quadratic and
+        # dominated fleet-squash host time
+        origin_idx: dict = {}
+        for i, layer in enumerate(real):
+            for pkg_info in layer.package_infos:
+                for p in pkg_info.packages:
+                    origin_idx.setdefault(
+                        (p.name, p.version, p.release),
+                        (layer.digest, layer.diff_id, i))
+
     for pkg in merged.packages:
         if single is not None:
             digest, diff_id = single.digest, single.diff_id
             pkg.build_info = single.build_info
         else:
-            digest, diff_id, idx = _origin_layer_pkg(pkg, real)
+            digest, diff_id, idx = origin_idx.get(
+                (pkg.name, pkg.version, pkg.release), ("", "", -1))
             pkg.build_info = _lookup_build_info(idx, real)
         pkg.layer = Layer(digest=digest, diff_id=diff_id)
         if pkg.name in dpkg_licenses:
@@ -150,16 +163,6 @@ def apply_layers(layers: list) -> ArtifactDetail:
 
     _aggregate(merged)
     return merged
-
-
-def _origin_layer_pkg(pkg, layers) -> tuple:
-    for i, layer in enumerate(layers):
-        for pkg_info in layer.package_infos:
-            for p in pkg_info.packages:
-                if (p.name, p.version, p.release) == \
-                        (pkg.name, pkg.version, pkg.release):
-                    return layer.digest, layer.diff_id, i
-    return "", "", -1
 
 
 def _lookup_build_info(index: int, layers: list):

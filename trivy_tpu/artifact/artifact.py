@@ -24,7 +24,7 @@ from ..types import (ArtifactInfo, ArtifactReference, BlobInfo,
                      ImageMetadata, Secret)
 from ..utils import get_logger
 from .cache import calc_key
-from .image import ImageSource
+from .image import ImageSource, guess_base_layers
 from .walker import collect_layer_tar, walk_fs
 
 log = get_logger("artifact")
@@ -91,16 +91,36 @@ class ImageArtifact:
         versions = dict(self.group.versions())
         versions.update({f"handler/{k}": v
                          for k, v in handler_versions().items()})
-        blob_ids = [calc_key(d, versions, options=opts_key)
-                    for d in img.diff_ids]
+        # base-image layers skip secret scanning (image.go:215-218),
+        # so a layer's blob CONTENT depends on whether this image
+        # treats it as base — the flag must be in the key, or a
+        # shared cache would serve base-stripped secrets to an image
+        # that owns the layer (and vice versa). The reference keys
+        # all layers alike (image.go:152-169) and accepts that
+        # asymmetry; our keys never interoperate with its anyway.
+        base = set(guess_base_layers(img.diff_ids, img.config)) \
+            if self.opt.scan_secrets else set()
+        blob_ids = [
+            calc_key(d, versions,
+                     options=dict(opts_key, base_layer=True)
+                     if d in base else opts_key)
+            for d in img.diff_ids]
         artifact_id = calc_key(img.id, versions, options=opts_key)
 
-        missing_artifact, missing = self.cache.missing_blobs(
-            artifact_id, blob_ids)
+        try:
+            missing_artifact, missing = self.cache.missing_blobs(
+                artifact_id, blob_ids)
 
-        todo = [i for i, b in enumerate(blob_ids) if b in missing]
-        if todo:
-            self._inspect_layers(todo, blob_ids)
+            todo = [i for i, b in enumerate(blob_ids)
+                    if b in missing]
+            if todo:
+                self._inspect_layers(todo, blob_ids, base)
+        finally:
+            # layer reads are done — release the shared archive
+            # handle now rather than at GC (a 512-image fleet would
+            # otherwise hold 512 open fds), including on the
+            # fully-cached path where nothing was read
+            img.close()
         if missing_artifact:
             self.cache.put_artifact(artifact_id,
                                     self._artifact_info())
@@ -121,7 +141,12 @@ class ImageArtifact:
 
     # --- analysis ---
 
-    def _inspect_layers(self, todo: list, blob_ids: list) -> None:
+    def _inspect_layers(self, todo: list, blob_ids: list,
+                        base: set) -> None:
+        # secret scanning is skipped on base-image layers — their
+        # "secrets" belong to the base image's publisher, not this
+        # image (ref image.go:215-218); `base` also marked these
+        # layers' cache keys in inspect()
         layer_results = []
         all_candidates = []        # (layer_idx, path, content)
         for i in todo:
@@ -134,6 +159,8 @@ class ImageArtifact:
                         continue
                     self.group.analyze_file(result, path, read, size)
             layer_results.append((i, result, opq_dirs, wh_files))
+            if self.image.diff_ids[i] in base:
+                continue
             for path, content in result.secret_candidates:
                 all_candidates.append((i, path, content))
 

@@ -5,6 +5,13 @@ This environment is zero-egress, so the supported sources are local:
 docker-save tar (manifest.json), OCI image layout (index.json), or a
 directory in OCI layout form. Registry/daemon resolution plugs in
 behind the same ImageSource interface later.
+
+One ``tarfile.TarFile`` is opened per archive and shared by the
+format sniff, the manifest/config reads, and every layer open — the
+member index is parsed once (tarfile re-scans all headers per open,
+which dominated fleet-scan host time when each layer re-opened the
+outer tar). ``ImageSource.close()`` releases the handle; the image
+artifact closes it as soon as layer analysis is done.
 """
 
 from __future__ import annotations
@@ -33,10 +40,47 @@ class ImageSource:
     layers: list = field(default_factory=list)    # [LayerRef]
     repo_tags: list = field(default_factory=list)
     repo_digests: list = field(default_factory=list)
+    archive: Optional["_Archive"] = None
 
     @property
     def diff_ids(self) -> list:
         return [la.diff_id for la in self.layers]
+
+    def close(self) -> None:
+        """Release the shared archive handle (noop for OCI dirs).
+        Layer opens after close() re-open the archive on demand, so
+        closing early is always safe."""
+        if self.archive is not None:
+            self.archive.close()
+
+
+class _Archive:
+    """Shared handle on an image tarball: open lazily, parse the
+    member index once, re-open transparently if read after
+    close()."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tf: Optional[tarfile.TarFile] = None
+
+    def tf(self) -> tarfile.TarFile:
+        if self._tf is None:
+            self._tf = tarfile.open(self.path)
+        return self._tf
+
+    def names(self) -> list:
+        return self.tf().getnames()
+
+    def read(self, member: str) -> bytes:
+        f = self.tf().extractfile(member)
+        if f is None:
+            raise ValueError(f"missing member {member}")
+        return f.read()
+
+    def close(self) -> None:
+        if self._tf is not None:
+            self._tf.close()
+            self._tf = None
 
 
 def load_image(path: str, name: Optional[str] = None) -> ImageSource:
@@ -44,26 +88,29 @@ def load_image(path: str, name: Optional[str] = None) -> ImageSource:
     name = name or path
     if os.path.isdir(path):
         return _load_oci_dir(path, name)
-    with tarfile.open(path) as tf:
-        names = tf.getnames()
+    arch = _Archive(path)
+    try:
+        names = arch.names()
         if "manifest.json" in names:
-            return _load_docker_save(path, name)
+            return _load_docker_save(arch, name)
         if "index.json" in names:
-            return _load_oci_tar(path, name)
+            return _load_oci_tar(arch, name)
+    except Exception:
+        arch.close()
+        raise
+    arch.close()
     raise ValueError(f"unrecognized image archive: {path}")
 
 
 # --- docker save format ---
 
-def _load_docker_save(path: str, name: str) -> ImageSource:
-    with tarfile.open(path) as tf:
-        manifest = json.loads(_read(tf, "manifest.json"))[0]
-        config_name = manifest["Config"]
-        config = json.loads(_read(tf, config_name))
+def _load_docker_save(arch: _Archive, name: str) -> ImageSource:
+    manifest = json.loads(arch.read("manifest.json"))[0]
+    config = json.loads(arch.read(manifest["Config"]))
     diff_ids = config.get("rootfs", {}).get("diff_ids", [])
     layer_paths = manifest.get("Layers", [])
     layers = [
-        LayerRef(diff_id=d, open=_tar_member_opener(path, lp))
+        LayerRef(diff_id=d, open=_member_layer_opener(arch, lp))
         for d, lp in zip(diff_ids, layer_paths)
     ]
     image_id = "sha256:" + hashlib.sha256(
@@ -71,17 +118,18 @@ def _load_docker_save(path: str, name: str) -> ImageSource:
     return ImageSource(
         name=name, id=image_id, config=config, layers=layers,
         repo_tags=manifest.get("RepoTags") or [],
+        archive=arch,
     )
 
 
 # --- OCI layout ---
 
-def _load_oci_tar(path: str, name: str) -> ImageSource:
-    with tarfile.open(path) as tf:
-        index = json.loads(_read(tf, "index.json"))
-        read = lambda p: _read(tf, p)       # noqa: E731
-        return _load_oci(index, read, name,
-                         opener=lambda p: _tar_member_opener(path, p))
+def _load_oci_tar(arch: _Archive, name: str) -> ImageSource:
+    index = json.loads(arch.read("index.json"))
+    src = _load_oci(index, arch.read, name,
+                    opener=lambda p: _member_layer_opener(arch, p))
+    src.archive = arch
+    return src
 
 
 def _load_oci_dir(path: str, name: str) -> ImageSource:
@@ -126,24 +174,14 @@ def _blob_path(digest: str) -> str:
 
 # --- helpers ---
 
-def _read(tf: tarfile.TarFile, member: str) -> bytes:
-    f = tf.extractfile(member)
-    if f is None:
-        raise ValueError(f"missing member {member}")
-    return f.read()
-
-
 def _canon_json(obj) -> bytes:
     return json.dumps(obj, separators=(",", ":"),
                       sort_keys=True).encode()
 
 
-def _tar_member_opener(archive_path: str, member: str) -> Callable:
+def _member_layer_opener(arch: _Archive, member: str) -> Callable:
     def open_layer() -> tarfile.TarFile:
-        outer = tarfile.open(archive_path)
-        f = outer.extractfile(member)
-        data = f.read()
-        outer.close()
+        data = arch.read(member)
         if data[:2] == b"\x1f\x8b":
             data = gzip.decompress(data)
         return tarfile.open(fileobj=io.BytesIO(data))
@@ -156,3 +194,43 @@ def _open_layer_file(full: str) -> tarfile.TarFile:
     if data[:2] == b"\x1f\x8b":
         data = gzip.decompress(data)
     return tarfile.open(fileobj=io.BytesIO(data))
+
+
+def guess_base_layers(diff_ids: list, config: dict) -> list:
+    """Diff IDs belonging to the base image (ref image.go:407-459
+    guessBaseLayers): walk history bottom-up, skip the trailing
+    empty layers (this image's CMD/ENTRYPOINT), and treat the
+    nearest earlier CMD empty-layer as the end of the base image —
+    everything above it in history order is base. Empty layers are
+    absent from diff_ids, so the two lists are re-aligned while
+    collecting."""
+    history = (config or {}).get("history") or []
+    base_image_index = -1
+    found_non_empty = False
+    for i in range(len(history) - 1, -1, -1):
+        h = history[i]
+        empty = bool(h.get("empty_layer"))
+        if not found_non_empty:
+            if empty:
+                continue
+            found_non_empty = True
+        if not empty:
+            continue
+        created_by = h.get("created_by", "")
+        if created_by.startswith("/bin/sh -c #(nop)  CMD") or \
+                created_by.startswith("CMD"):      # BuildKit
+            base_image_index = i
+            break
+
+    out = []
+    diff_idx = 0
+    for i, h in enumerate(history):
+        if i > base_image_index:
+            break
+        if h.get("empty_layer"):
+            continue
+        if diff_idx >= len(diff_ids):
+            return []                   # history/diff mismatch
+        out.append(diff_ids[diff_idx])
+        diff_idx += 1
+    return out

@@ -96,6 +96,8 @@ class AdvisoryStore:
         self.buckets: dict = {}
         self.vulnerabilities: dict = {}
         self.data_sources: dict = {}
+        self._adv_cache: dict = {}      # (bucket, pkg) → [Advisory]
+        self._detail_cache: dict = {}   # vuln id → detail
 
     # --- writes ---
 
@@ -103,19 +105,30 @@ class AdvisoryStore:
                      value: dict) -> None:
         self.buckets.setdefault(bucket, {}) \
             .setdefault(pkg, {})[vuln_id] = value
+        self._adv_cache.pop((bucket, pkg), None)
 
     def put_vulnerability(self, vuln_id: str, value: dict) -> None:
         self.vulnerabilities[vuln_id] = value
+        self._detail_cache.pop(vuln_id, None)
 
     def put_data_source(self, bucket: str, value: dict) -> None:
         self.data_sources[bucket] = value
+        self._adv_cache = {k: v for k, v in self._adv_cache.items()
+                           if k[0] != bucket}
 
     # --- reads (db.Config semantics) ---
 
     def get(self, bucket: str, pkg_name: str) -> list:
         """Advisories for one package in one bucket. Non-dict values
         (metadata buckets like "Red Hat CPE" repo→CPE maps) are not
-        advisories and are skipped."""
+        advisories and are skipped. Decoded Advisory lists are
+        memoized per (bucket, pkg): a 512-image fleet asks for the
+        same handful of packages tens of thousands of times, and
+        re-building dataclasses dominated the job-prep phase."""
+        key = (bucket, pkg_name)
+        cached = self._adv_cache.get(key)
+        if cached is not None:
+            return cached
         out = []
         for vid, v in (self.buckets.get(bucket, {})
                        .get(pkg_name, {})).items():
@@ -125,6 +138,7 @@ class AdvisoryStore:
             if adv.data_source is None:
                 adv.data_source = self._bucket_source(bucket)
             out.append(adv)
+        self._adv_cache[key] = out
         return out
 
     def get_advisories(self, prefix: str, pkg_name: str) -> list:
@@ -137,10 +151,17 @@ class AdvisoryStore:
 
     def get_vulnerability(self, vuln_id: str)\
             -> Optional[VulnerabilityDetail]:
+        """Memoized like get(): enrichment asks for the same CVE
+        once per affected image across a fleet."""
+        detail = self._detail_cache.get(vuln_id)
+        if detail is not None:
+            return detail
         v = self.vulnerabilities.get(vuln_id)
         if v is None:
             return None
-        return VulnerabilityDetail.from_dict(vuln_id, v)
+        detail = VulnerabilityDetail.from_dict(vuln_id, v)
+        self._detail_cache[vuln_id] = detail
+        return detail
 
     def _bucket_source(self, bucket: str) -> Optional[DataSource]:
         d = self.data_sources.get(bucket)
