@@ -353,3 +353,131 @@ class TestBaseLayerSecretGating:
         paths = {r["Target"] for r in rep.get("Results") or []
                  if r.get("Secrets")}
         assert paths == {"/app/base-secret.env", "/app/mine.env"}
+
+
+class TestRemovedPackages:
+    """--removed-pkgs: packages installed-then-deleted in the
+    Dockerfile, reconstructed from RUN history against an APKINDEX
+    archive (ref analyzer/command/apk/apk.go + local/scan.go:181)."""
+
+    INDEX = {
+        "Package": {
+            "curl": {"Versions": {"7.61.0-r0": 1530000000,
+                                  "7.64.0-r1": 1550000000},
+                     "Dependencies": ["so:libssl.so.1.1"]},
+            "libssl1.1": {"Versions": {"1.1.1a-r0": 1540000000}},
+        },
+        "Provide": {"SO": {"libssl.so.1.1":
+                           {"Package": "libssl1.1"}},
+                    "Package": {}},
+    }
+
+    def _image(self, tmp_path):
+        img = make_image_tar(tmp_path, [
+            {"etc/alpine-release": b"3.9.4\n",
+             "lib/apk/db/installed": APK_INSTALLED}])
+        import tarfile as _tar, io as _io, json as _json, pathlib
+        with _tar.open(img) as tf:
+            members = {m.name: tf.extractfile(m).read()
+                       for m in tf if m.isfile()}
+        manifest = _json.loads(members["manifest.json"])
+        cfg = _json.loads(members[manifest[0]["Config"]])
+        cfg["history"] = [
+            {"created": "2019-03-01T00:00:00Z",
+             "created_by": "/bin/sh -c apk add curl && "
+                           "rm -rf /var/cache/apk && apk del curl"},
+        ]
+        members[manifest[0]["Config"]] = _json.dumps(cfg).encode()
+        out = pathlib.Path(img).with_name("hist.tar")
+        with _tar.open(out, "w") as tf:
+            for name, data in members.items():
+                info = _tar.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+        return str(out)
+
+    def _db(self, tmp_path):
+        p = tmp_path / "db.yaml"
+        p.write_text(FIXTURE_DB.replace(
+            "    - bucket: musl",
+            "    - bucket: curl\n"
+            "      pairs:\n"
+            "        - key: CVE-2019-5481\n"
+            "          value: {FixedVersion: 7.66.0-r0}\n"
+            "    - bucket: musl", 1))
+        return str(p)
+
+    def test_removed_pkg_detected(self, tmp_path, monkeypatch):
+        import json as _json
+        idx = tmp_path / "apkindex.json"
+        idx.write_text(_json.dumps(self.INDEX))
+        monkeypatch.setenv("TRIVY_APK_INDEX_ARCHIVE_URL",
+                           f"file://{idx}")
+        img = self._image(tmp_path)
+        db = self._db(tmp_path)
+        out = tmp_path / "r.json"
+        code, _ = run_cli([
+            "image", "--input", img, "--removed-pkgs",
+            "--format", "json", "--output", str(out),
+            "--db-fixtures", db, "--backend", "cpu",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        rep = _json.loads(out.read_text())
+        ids = {(v["PkgName"], v["VulnerabilityID"])
+               for r in rep["Results"]
+               for v in r.get("Vulnerabilities", [])}
+        # curl was apk-deleted but history + index reconstruct
+        # version 7.64.0-r1 (newest build <= layer created)
+        assert ("curl", "CVE-2019-5481") in ids
+        assert ("musl", "CVE-2019-14697") in ids
+
+    def test_without_flag_no_history_pkgs(self, tmp_path,
+                                          monkeypatch):
+        import json as _json
+        idx = tmp_path / "apkindex.json"
+        idx.write_text(_json.dumps(self.INDEX))
+        monkeypatch.setenv("TRIVY_APK_INDEX_ARCHIVE_URL",
+                           f"file://{idx}")
+        img = self._image(tmp_path)
+        out = tmp_path / "r.json"
+        code, _ = run_cli([
+            "image", "--input", img, "--format", "json",
+            "--output", str(out), "--db-fixtures",
+            self._db(tmp_path), "--backend", "cpu",
+            "--cache-dir", str(tmp_path / "c")])
+        assert code == 0
+        rep = _json.loads(out.read_text())
+        names = {v["PkgName"] for r in rep["Results"]
+                 for v in r.get("Vulnerabilities", [])}
+        assert "curl" not in names
+
+    def test_env_set_after_first_scan_not_stale(self, tmp_path,
+                                                monkeypatch):
+        """The APK index URL keys the artifact record: setting it
+        after a cached scan must re-run the history analyzer, even
+        when every layer (incl. the OS layer) is a cache hit."""
+        import json as _json
+        img = self._image(tmp_path)
+        db = self._db(tmp_path)
+        cache = str(tmp_path / "c")
+        out = tmp_path / "r.json"
+        code, _ = run_cli([
+            "image", "--input", img, "--removed-pkgs",
+            "--format", "json", "--output", str(out),
+            "--db-fixtures", db, "--backend", "cpu",
+            "--cache-dir", cache])
+        assert code == 0          # no index -> no curl
+        idx = tmp_path / "apkindex.json"
+        idx.write_text(_json.dumps(self.INDEX))
+        monkeypatch.setenv("TRIVY_APK_INDEX_ARCHIVE_URL",
+                           f"file://{idx}")
+        code, _ = run_cli([
+            "image", "--input", img, "--removed-pkgs",
+            "--format", "json", "--output", str(out),
+            "--db-fixtures", db, "--backend", "cpu",
+            "--cache-dir", cache])
+        assert code == 0
+        rep = _json.loads(out.read_text())
+        names = {v["PkgName"] for r in rep["Results"]
+                 for v in r.get("Vulnerabilities", [])}
+        assert "curl" in names

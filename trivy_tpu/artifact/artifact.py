@@ -87,7 +87,13 @@ class ImageArtifact:
                     # (different servers hold different
                     # attestations), so it keys cached blobs
                     "rekor": _os.environ.get(
-                        "TRIVY_REKOR_URL", "")}
+                        "TRIVY_REKOR_URL", ""),
+                    # likewise the APK index URL decides what
+                    # history_packages the artifact record holds
+                    "apk_index": _os.environ.get(
+                        "TRIVY_APK_INDEX_ARCHIVE_URL",
+                        _os.environ.get(
+                            "FANAL_APK_INDEX_ARCHIVE_URL", ""))}
         versions = dict(self.group.versions())
         versions.update({f"handler/{k}": v
                          for k, v in handler_versions().items()})
@@ -115,6 +121,17 @@ class ImageArtifact:
                     if b in missing]
             if todo:
                 self._inspect_layers(todo, blob_ids, base)
+            if missing_artifact and \
+                    getattr(self, "_os_found", None) is None:
+                # OS layer may be a cache hit while the artifact
+                # record is being (re)built — read it from the
+                # cached blobs so the history analyzer still knows
+                # the distro/version
+                for b in blob_ids:
+                    blob = self.cache.get_blob(b)
+                    if blob is not None and blob.os is not None:
+                        self._os_found = blob.os
+                        break
         finally:
             # layer reads are done — release the shared archive
             # handle now rather than at GC (a 512-image fleet would
@@ -159,6 +176,10 @@ class ImageArtifact:
                         continue
                     self.group.analyze_file(result, path, read, size)
             layer_results.append((i, result, opq_dirs, wh_files))
+            if result.os is not None:
+                # feeds the image-config history analyzer, like the
+                # reference's osFound (image.go:206-250)
+                self._os_found = result.os
             if self.image.diff_ids[i] in base:
                 continue
             for path, content in result.secret_candidates:
@@ -199,12 +220,20 @@ class ImageArtifact:
             in self.opt.skip_files or path in self.opt.skip_files
 
     def _artifact_info(self) -> ArtifactInfo:
+        """inspectConfig analog (ref image.go:349-376): image
+        metadata plus packages reconstructed from RUN history for
+        --removed-pkgs scanning."""
+        from ..analyzer.imgconf import analyze_image_config
         cfg = self.image.config
+        os_found = getattr(self, "_os_found", None)
         return ArtifactInfo(
             architecture=cfg.get("architecture", ""),
             created=cfg.get("created", ""),
             docker_version=cfg.get("docker_version", ""),
             os=cfg.get("os", ""),
+            history_packages=analyze_image_config(
+                os_found.family if os_found else "",
+                os_found.name if os_found else "", cfg),
         )
 
 

@@ -137,6 +137,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "(tarball or OCI layout)")
     img.add_argument("--input", default="",
                      help="image tarball path (docker save / OCI)")
+    img.add_argument("--removed-pkgs", action="store_true",
+                     help="also scan packages installed and later "
+                     "removed in the Dockerfile (reconstructed "
+                     "from RUN history; alpine only, needs "
+                     "TRIVY_APK_INDEX_ARCHIVE_URL)")
     img.add_argument("target", nargs="?", default="")
     scan_flags(img)
 
@@ -737,6 +742,7 @@ def _scan_options(args) -> ScanOptions:
         list_all_packages=args.list_all_pkgs or
         getattr(args, "dependency_tree", False) or
         args.format in _SBOM_FORMATS,
+        scan_removed_packages=getattr(args, "removed_pkgs", False),
         backend="cpu-ref" if args.backend == "cpu-ref" else args.backend,
     )
 

@@ -134,6 +134,8 @@ class RemoteScanner(_Client):
                 "vuln_type": list(options.vuln_type),
                 "security_checks": list(options.security_checks),
                 "list_all_packages": options.list_all_packages,
+                "scan_removed_packages":
+                    options.scan_removed_packages,
                 "backend": getattr(options, "backend", "tpu"),
             },
         })

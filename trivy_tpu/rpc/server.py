@@ -81,6 +81,8 @@ class ScanServer:
             vuln_type=opts.get("vuln_type") or ["os", "library"],
             security_checks=opts.get("security_checks") or ["vuln"],
             list_all_packages=opts.get("list_all_packages", False),
+            scan_removed_packages=opts.get(
+                "scan_removed_packages", False),
             backend=opts.get("backend", "tpu"),
         )
         # readers hold the store across the whole scan; swap waits

@@ -310,5 +310,6 @@ def artifact_info_from_dict(d: dict) -> ArtifactInfo:
         created=d.get("Created", ""),
         docker_version=d.get("DockerVersion", ""),
         os=d.get("OS", ""),
-        history_packages=d.get("HistoryPackages") or [],
+        history_packages=[package_from_dict(p) for p in
+                          d.get("HistoryPackages") or []],
     )
