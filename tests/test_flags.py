@@ -166,3 +166,30 @@ class TestTimeout:
         d.mkdir()
         code, _ = _run(["fs", str(d), "--timeout", "bogus"])
         assert code == 2
+
+
+class TestGenerateDefaultConfig:
+    """--generate-default-config dumps resolved flags to
+    trivy-default.yaml and exits (ref run.go:354
+    viper.SafeWriteConfigAs)."""
+
+    def test_writes_and_refuses_overwrite(self, tmp_path):
+        code, _ = _run(["fs", ".", "--generate-default-config",
+                        "--severity", "HIGH"], cwd=tmp_path)
+        assert code == 0
+        text = (tmp_path / "trivy-default.yaml").read_text()
+        assert "severity: HIGH" in text
+        assert "format:" in text
+        code, _ = _run(["fs", ".", "--generate-default-config"],
+                       cwd=tmp_path)
+        assert code == 1            # SafeWrite: no overwrite
+
+    def test_keys_round_trip_through_config(self, tmp_path):
+        # dest-renamed flags (--token -> auth_token) must emit
+        # under their FLAG name, which the config loader reads
+        code, _ = _run(["fs", ".", "--generate-default-config",
+                        "--token", "SECRET123"], cwd=tmp_path)
+        assert code == 0
+        text = (tmp_path / "trivy-default.yaml").read_text()
+        assert "token: SECRET123" in text
+        assert "auth-token" not in text

@@ -10,6 +10,7 @@ names so invocations port over (``--severity``, ``--security-checks``,
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from tarfile import TarError as tarfile_error
@@ -112,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "which attributes the HCL subset could not "
                         "evaluate, so 'no findings' is "
                         "distinguishable from 'couldn't evaluate'")
+        sp.add_argument("--generate-default-config",
+                        action="store_true",
+                        help="write the resolved flag values to "
+                        "trivy-default.yaml and exit (ref "
+                        "run.go:354)")
         sp.add_argument("--no-cache", action="store_true")
         sp.add_argument("--cache-backend", default="fs",
                         help="layer cache backend: fs | "
@@ -349,6 +355,8 @@ def _dispatch(args) -> int:
     if args.command in (None, "version"):
         print(f"trivy-tpu {__version__}")
         return 0
+    if getattr(args, "generate_default_config", False):
+        return _generate_default_config(args)
     if args.command in ("image", "filesystem", "fs", "rootfs",
                         "repo", "sbom", "k8s", "config", "conf"):
         from .module import Manager as _ModuleManager
@@ -407,6 +415,42 @@ def run_aws(args) -> int:
         results=results,
     )
     return _finish(args, report)
+
+
+def _generate_default_config(args) -> int:
+    """--generate-default-config: dump the resolved flag values
+    (CLI > env > config-file layering already applied) to
+    trivy-default.yaml, refusing to overwrite — viper's
+    SafeWriteConfigAs (ref run.go:354). Keys are the FLAG names
+    (--token → ``token``), exactly what apply_external_defaults
+    reads back, so the file round-trips through --config."""
+    import yaml
+    from .flag import _walk_parsers
+    dest_to_flag = {}
+    for p in _walk_parsers(build_parser()):
+        for action in p._actions:
+            longs = [o for o in action.option_strings
+                     if o.startswith("--")]
+            if longs:
+                dest_to_flag.setdefault(action.dest, longs[0][2:])
+    skip = {"command", "target", "input", "generate_default_config",
+            "help", "version", "config"}
+    doc = {}
+    for key, value in vars(args).items():
+        flag = dest_to_flag.get(key)
+        if flag is None or key in skip:
+            continue
+        doc[flag] = value
+    out = "trivy-default.yaml"
+    try:
+        with open(out, "x", encoding="utf-8") as f:
+            yaml.safe_dump(doc, f, sort_keys=True,
+                           default_flow_style=False)
+    except FileExistsError:
+        print(f"error: {out} already exists", file=sys.stderr)
+        return 1
+    print(f"wrote {out}")
+    return 0
 
 
 def run_module(args) -> int:
