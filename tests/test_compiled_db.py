@@ -77,13 +77,19 @@ def test_compiled_matches_host_on_fixtures(fixture_store,
                                 version, adv.fixed_version) < 0
                         else:
                             want = True
+                    # one vuln id can appear in several advisories
+                    # of the same package (redhat-oval entries with
+                    # different fixed versions) — match the exact
+                    # advisory, not just the id
                     rows = [i for i in
                             cdb.candidate_rows(bucket, pkg)
-                            if cdb.rows_meta[i][2].vulnerability_id ==
-                            adv.vulnerability_id and
-                            cdb.rows_meta[i][2] is adv or
-                            cdb.rows_meta[i][2].vulnerability_id ==
-                            adv.vulnerability_id]
+                            if cdb.rows_meta[i][2] is adv
+                            or (cdb.rows_meta[i][2]
+                                .vulnerability_id ==
+                                adv.vulnerability_id
+                                and cdb.rows_meta[i][2]
+                                .fixed_version ==
+                                adv.fixed_version)]
                     assert rows
                     jobs = [ResidentPairJob(
                         cdb=cdb, row=rows[0], grammar=grammar,

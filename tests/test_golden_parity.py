@@ -301,3 +301,119 @@ def test_image_golden_alpine39(label, extra, golden, tmp_path,
     want = _norm_image(json.load(open(os.path.join(
         REF, "testdata", golden))))
     assert ours == want
+
+
+DEBIAN_STRETCH_STATUS = """\
+Package: bash
+Status: install ok installed
+Version: 4.4-5
+Architecture: amd64
+
+Package: e2fslibs
+Status: install ok installed
+Source: e2fsprogs
+Version: 1.43.4-2
+Architecture: amd64
+
+Package: e2fsprogs
+Status: install ok installed
+Version: 1.43.4-2
+Architecture: amd64
+
+Package: libcomerr2
+Status: install ok installed
+Source: e2fsprogs
+Version: 1.43.4-2
+Architecture: amd64
+
+Package: libss2
+Status: install ok installed
+Source: e2fsprogs
+Version: 1.43.4-2
+Architecture: amd64
+"""
+
+
+def test_image_golden_debian_stretch(tmp_path, monkeypatch):
+    """Full-report diff of a DEBIAN image scan against
+    debian-stretch.json.golden — a second distro family beyond the
+    alpine goldens (dpkg status + source-package attribution +
+    unfixed-severity-only advisories)."""
+    from trivy_tpu import cli
+    from trivy_tpu.utils.synth import write_image_tar
+    golden = json.load(open(os.path.join(
+        REF, "testdata", "debian-stretch.json.golden")))
+    out_dir = os.path.join(str(tmp_path), "testdata", "fixtures",
+                           "images")
+    os.makedirs(out_dir, exist_ok=True)
+    write_image_tar(
+        os.path.join(out_dir, "debian-stretch.tar.gz"),
+        [{"etc/debian_version": b"9.9\n",
+          "var/lib/dpkg/status": DEBIAN_STRETCH_STATUS.encode()}],
+        config=golden["Metadata"]["ImageConfig"],
+        gzipped=True)
+    db = _db_paths()
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "image", "--input",
+        "testdata/fixtures/images/debian-stretch.tar.gz",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--security-checks", "vuln",
+        "--db-fixtures", db])
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(json.load(open(os.path.join(
+        REF, "testdata", "debian-stretch.json.golden"))))
+    assert ours == want
+
+
+def test_image_golden_centos7(tmp_path, monkeypatch):
+    """Full-report diff of a CENTOS image scan against
+    centos-7.json.golden — exercises the rpmdb (BDB) reader, the
+    redhat-oval v2 advisory schema (Entries + CPE indices → the
+    "Red Hat CPE" repository mapping), epoch-carrying versions, and
+    default-content-set narrowing (the el6-only RHSA-2019:2471
+    entry must be suppressed for a el7 host)."""
+    from tests.test_rpm import make_bdb, make_header
+    from trivy_tpu import cli
+    from trivy_tpu.utils.synth import write_image_tar
+    golden = json.load(open(os.path.join(
+        REF, "testdata", "centos-7.json.golden")))
+    rpmdb = make_bdb([
+        make_header("bash", "4.2.46", "31.el7",
+                    sourcerpm="bash-4.2.46-31.el7.src.rpm"),
+        make_header("openssl-libs", "1.0.2k", "16.el7", epoch=1,
+                    sourcerpm="openssl-1.0.2k-16.el7.src.rpm"),
+    ])
+    out_dir = os.path.join(str(tmp_path), "testdata", "fixtures",
+                           "images")
+    os.makedirs(out_dir, exist_ok=True)
+    write_image_tar(
+        os.path.join(out_dir, "centos-7.tar.gz"),
+        [{"etc/centos-release":
+          b"CentOS Linux release 7.6.1810 (Core)\n",
+          "var/lib/rpm/Packages": rpmdb}],
+        config=golden["Metadata"]["ImageConfig"],
+        gzipped=True)
+    db = _db_paths()
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "image", "--input",
+        "testdata/fixtures/images/centos-7.tar.gz",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--security-checks", "vuln",
+        "--db-fixtures", db])
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(json.load(open(os.path.join(
+        REF, "testdata", "centos-7.json.golden"))))
+    # EOSL is computed against the wall clock; the golden predates
+    # CentOS 7's 2024-06-30 EOL, so the reference run today would
+    # emit it too (centosEOLDates, redhat.go:54-62)
+    ours["Metadata"]["OS"].pop("EOSL", None)
+    want["Metadata"]["OS"].pop("EOSL", None)
+    assert ours == want

@@ -158,10 +158,12 @@ def test_redhat_family_supported():
     from trivy_tpu.detect import ospkg_detect
     from trivy_tpu.types import Package
     store = AdvisoryStore()
-    store.put_advisory("Red Hat", "openssl", "CVE-2020-1971",
+    store.put_advisory("Red Hat", "openssl-libs", "CVE-2020-1971",
                        {"FixedVersion": "1:1.1.1g-12.el8_3",
                         "Severity": 2})
-    pkgs = [Package(name="openssl", src_name="openssl",
+    # advisories key by BINARY name + binary EVR (redhat.go:127,143)
+    pkgs = [Package(name="openssl-libs", version="1.1.1c",
+                    release="2.el8", epoch=1, src_name="openssl",
                     src_version="1.1.1c", src_release="2.el8",
                     src_epoch=1)]
     vulns, _ = ospkg_detect("redhat", "8.3", None, pkgs, store)

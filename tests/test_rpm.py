@@ -232,7 +232,7 @@ def test_centos_image_scan_rpm_vulns(tmp_path):
     fixtures.write_text("""
 - bucket: Red Hat
   pairs:
-    - bucket: openssl
+    - bucket: openssl-libs
       pairs:
         - key: CVE-2020-1971
           value: {FixedVersion: "1:1.1.1g-12.el8_3", Severity: 3}
@@ -281,7 +281,7 @@ def test_centos_image_scan_compiled_db(tmp_path):
     fixtures.write_text("""
 - bucket: Red Hat
   pairs:
-    - bucket: openssl
+    - bucket: openssl-libs
       pairs:
         - key: CVE-2020-1971
           value: {FixedVersion: "1:1.1.1g-12.el8_3", Severity: 3}

@@ -114,6 +114,9 @@ class LsbReleaseAnalyzer(Analyzer):
 _REDHAT_FILES = {
     "etc/oracle-release": "oracle",
     "etc/fedora-release": "fedora",
+    "etc/centos-release": "centos",   # ref redhatbase/centos.go:51
+    "etc/rocky-release": "rocky",     # ref redhatbase/rocky.go:51
+    "etc/almalinux-release": "alma",  # ref redhatbase/alma.go:51
     "etc/redhat-release": None,       # family parsed from content
     "etc/system-release": None,
     # Amazon Linux 2022 moved the release file

@@ -98,6 +98,7 @@ class AdvisoryStore:
         self.data_sources: dict = {}
         self._adv_cache: dict = {}      # (bucket, pkg) → [Advisory]
         self._detail_cache: dict = {}   # vuln id → detail
+        self._cpe_names = None          # index → [repo/nvr names]
 
     # --- writes ---
 
@@ -106,6 +107,10 @@ class AdvisoryStore:
         self.buckets.setdefault(bucket, {}) \
             .setdefault(pkg, {})[vuln_id] = value
         self._adv_cache.pop((bucket, pkg), None)
+        if bucket == "Red Hat CPE":
+            # the CPE mapping feeds every expanded Red Hat advisory
+            self._cpe_names = None
+            self._adv_cache = {}
 
     def put_vulnerability(self, vuln_id: str, value: dict) -> None:
         self.vulnerabilities[vuln_id] = value
@@ -134,12 +139,72 @@ class AdvisoryStore:
                        .get(pkg_name, {})).items():
             if not isinstance(v, dict):
                 continue
+            if "Entries" in v:
+                # trivy-db redhat-oval v2 record (vulnsrc
+                # redhat-oval: per-entry CPE indices + CVE list)
+                out.extend(self._expand_redhat(vid, v, bucket))
+                continue
             adv = Advisory.from_dict(vid, v)
             if adv.data_source is None:
                 adv.data_source = self._bucket_source(bucket)
             out.append(adv)
         self._adv_cache[key] = out
         return out
+
+    def _expand_redhat(self, key: str, value: dict,
+                       bucket: str) -> list:
+        """redhat-oval schema → flat advisories: one per
+        (entry, CVE), with the entry's Affected CPE indices
+        translated back to repository/NVR names so the Red Hat
+        driver's content-set narrowing applies
+        (redhat.go:129-138 + trivy-db redhat-oval Get). The
+        advisory key is a CVE id or an RHSA/RHBA vendor id; vendor
+        keys surface as VendorIDs on each carried CVE."""
+        idx_names = self._cpe_index_names()
+        out = []
+        for entry in value.get("Entries") or []:
+            affected = []
+            for i in entry.get("Affected") or []:
+                try:
+                    affected.append(int(i))
+                except (TypeError, ValueError):
+                    continue        # malformed row: skip, not crash
+            sets = sorted({name for i in affected
+                           for name in idx_names.get(i, [])})
+            if affected and not sets:
+                # indices with no known repository/NVR: keep the
+                # entry narrowed (it can never match), not open
+                sets = [f"cpe-index:{i}" for i in affected]
+            cves = entry.get("Cves") or [{}]
+            for cve in cves:
+                vuln_id = cve.get("ID") or key
+                out.append(Advisory(
+                    vulnerability_id=vuln_id,
+                    fixed_version=entry.get("FixedVersion", ""),
+                    arches=list(entry.get("Arches") or []),
+                    severity=int(cve.get("Severity", 0) or 0),
+                    vendor_ids=[key] if key != vuln_id else [],
+                    content_sets=sets,
+                    data_source=self._bucket_source(bucket)))
+        return out
+
+    def _cpe_index_names(self) -> dict:
+        """index → [repository/NVR names] inverted from the
+        "Red Hat CPE" bucket's repository and nvr sub-buckets."""
+        if self._cpe_names is None:
+            inv: dict = {}
+            cpe = self.buckets.get("Red Hat CPE", {})
+            for sub in ("repository", "nvr"):
+                for name, indices in (cpe.get(sub) or {}).items():
+                    if not isinstance(indices, list):
+                        continue
+                    for i in indices:
+                        try:
+                            inv.setdefault(int(i), []).append(name)
+                        except (TypeError, ValueError):
+                            continue
+            self._cpe_names = inv
+        return self._cpe_names
 
     def get_advisories(self, prefix: str, pkg_name: str) -> list:
         """Prefix scan over buckets (e.g. ``pip::``) — driver.go:83."""

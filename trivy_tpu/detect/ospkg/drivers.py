@@ -333,10 +333,15 @@ class _RedHat(Driver):
         return False
 
     def src_name(self, pkg) -> str:
-        name = pkg.src_name or pkg.name
-        if pkg.modularity_label:
-            return add_modular_namespace(name, pkg.modularity_label)
-        return name
+        # Red Hat OVAL v2 keys advisories by BINARY package name
+        # (redhat.go:127 uses pkg.Name, not SrcName)
+        return add_modular_namespace(pkg.name,
+                                     pkg.modularity_label) \
+            if pkg.modularity_label else pkg.name
+
+    def installed(self, pkg) -> str:
+        # binary EVR, not source (redhat.go:143 FormatVersion)
+        return format_version(pkg.epoch, pkg.version, pkg.release)
 
     def eol_key(self, os_ver: str) -> str:
         # "8.4.2105" → "8" (redhat.go:212-214)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional
 
-from ..types import Severity
+from ..types import SEVERITIES, Severity
 
 
 class IgnorePolicyError(Exception):
@@ -72,11 +72,19 @@ def filter_results(results: list, severities: list,
     sev_names = {str(s) if isinstance(s, Severity) else s
                  for s in severities}
     ignored = set(ignored_ids or [])
+    sev_rank = {str(s): i for i, s in enumerate(SEVERITIES)}
 
     for r in results:
         r.vulnerabilities = _filter_vulns(
             r.vulnerabilities, sev_names, ignore_unfixed, ignored,
             policy)
+        # BySeverity ordering (ref types/vulnerability.go:44-57,
+        # applied after filtering at filter.go:47): package, then
+        # installed version, then severity DESCENDING, then id
+        r.vulnerabilities.sort(
+            key=lambda v: (v.pkg_name, v.installed_version,
+                           -sev_rank.get(v.severity, 0),
+                           v.vulnerability_id))
         r.misconf_summary, r.misconfigurations = _filter_misconfs(
             r.misconfigurations, sev_names, ignored,
             include_non_failures, policy)
