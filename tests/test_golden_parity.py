@@ -417,3 +417,118 @@ def test_image_golden_centos7(tmp_path, monkeypatch):
     ours["Metadata"]["OS"].pop("EOSL", None)
     want["Metadata"]["OS"].pop("EOSL", None)
     assert ours == want
+
+
+def _centos7_tar(tmp_path, golden):
+    from tests.test_rpm import make_bdb, make_header
+    from trivy_tpu.utils.synth import write_image_tar
+    rpmdb = make_bdb([
+        make_header("bash", "4.2.46", "31.el7",
+                    sourcerpm="bash-4.2.46-31.el7.src.rpm"),
+        make_header("openssl-libs", "1.0.2k", "16.el7", epoch=1,
+                    sourcerpm="openssl-1.0.2k-16.el7.src.rpm"),
+    ])
+    out_dir = os.path.join(str(tmp_path), "testdata", "fixtures",
+                           "images")
+    os.makedirs(out_dir, exist_ok=True)
+    write_image_tar(
+        os.path.join(out_dir, "centos-7.tar.gz"),
+        [{"etc/centos-release":
+          b"CentOS Linux release 7.6.1810 (Core)\n",
+          "var/lib/rpm/Packages": rpmdb}],
+        config=golden["Metadata"]["ImageConfig"], gzipped=True)
+
+
+CENTOS7_CASES = [
+    ("ignore-unfixed", ["--ignore-unfixed"],
+     "centos-7-ignore-unfixed.json.golden"),
+    ("medium", ["--severity", "MEDIUM"],
+     "centos-7-medium.json.golden"),
+]
+
+
+@pytest.mark.parametrize("label,extra,golden_name", CENTOS7_CASES,
+                         ids=[c[0] for c in CENTOS7_CASES])
+def test_image_golden_centos7_variants(label, extra, golden_name,
+                                       tmp_path, monkeypatch):
+    """centos-7 flag variants (ref standalone_tar_test.go):
+    --ignore-unfixed must drop the unfixed bash advisory;
+    --severity MEDIUM keeps only CVE-2019-1559."""
+    from trivy_tpu import cli
+    golden = json.load(open(os.path.join(
+        REF, "testdata", golden_name)))
+    _centos7_tar(tmp_path, golden)
+    db = _db_paths()
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / f"report-{label}.json"
+    rc = cli.main([
+        "image", "--input",
+        "testdata/fixtures/images/centos-7.tar.gz",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--security-checks", "vuln",
+        "--db-fixtures", db, *extra])
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(golden)
+    ours["Metadata"]["OS"].pop("EOSL", None)
+    want["Metadata"]["OS"].pop("EOSL", None)
+    assert ours == want
+
+
+DEBIAN_BUSTER_STATUS = """\
+Package: bash
+Status: install ok installed
+Version: 5.0-4
+Architecture: amd64
+
+Package: libidn2-0
+Status: install ok installed
+Source: libidn2
+Version: 2.0.5-1
+Architecture: amd64
+"""
+
+BUSTER_CASES = [
+    ("plain", [], "debian-buster.json.golden"),
+    ("ignore-unfixed", ["--ignore-unfixed"],
+     "debian-buster-ignore-unfixed.json.golden"),
+]
+
+
+@pytest.mark.parametrize("label,extra,golden_name", BUSTER_CASES,
+                         ids=[c[0] for c in BUSTER_CASES])
+def test_image_golden_debian_buster(label, extra, golden_name,
+                                    tmp_path, monkeypatch):
+    """debian-buster image goldens: binary package with a different
+    source name (libidn2-0 ← libidn2) and the unfixed-bash variant."""
+    from trivy_tpu import cli
+    from trivy_tpu.utils.synth import write_image_tar
+    golden = json.load(open(os.path.join(
+        REF, "testdata", golden_name)))
+    out_dir = os.path.join(str(tmp_path), "testdata", "fixtures",
+                           "images")
+    os.makedirs(out_dir, exist_ok=True)
+    write_image_tar(
+        os.path.join(out_dir, "debian-buster.tar.gz"),
+        [{"etc/debian_version": b"10.1\n",
+          "var/lib/dpkg/status": DEBIAN_BUSTER_STATUS.encode()}],
+        config=golden["Metadata"]["ImageConfig"], gzipped=True)
+    db = _db_paths()
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / f"report-{label}.json"
+    rc = cli.main([
+        "image", "--input",
+        "testdata/fixtures/images/debian-buster.tar.gz",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--security-checks", "vuln",
+        "--db-fixtures", db, *extra])
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(golden)
+    # EOSL is wall-clock-derived; debian 10 went EOL (2024-06-30)
+    # after the golden was committed
+    ours["Metadata"]["OS"].pop("EOSL", None)
+    want["Metadata"]["OS"].pop("EOSL", None)
+    assert ours == want
