@@ -238,6 +238,43 @@ def _norm_image(o):
     return o
 
 
+
+def _run_image_golden(tmp_path, monkeypatch, tar_name, layers,
+                      golden_name, extra=(), drop_eosl=False,
+                      config_from=None):
+    """Shared image-golden drill: synthesize the docker-save tar
+    from the golden's own ImageConfig, run the CLI, and diff the
+    normalized reports. drop_eosl: the distro went EOL after the
+    golden was committed, so the wall-clock-derived flag differs."""
+    from trivy_tpu import cli
+    from trivy_tpu.utils.synth import write_image_tar
+    golden = json.load(open(os.path.join(
+        REF, "testdata", golden_name)))
+    out_dir = os.path.join(str(tmp_path), "testdata", "fixtures",
+                           "images")
+    os.makedirs(out_dir, exist_ok=True)
+    write_image_tar(
+        os.path.join(out_dir, tar_name), layers,
+        config=(config_from or golden)["Metadata"]["ImageConfig"],
+        gzipped=True)
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / f"report-{golden_name}.json"
+    rc = cli.main([
+        "image", "--input",
+        f"testdata/fixtures/images/{tar_name}",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--security-checks", "vuln",
+        "--db-fixtures", _db_paths(), *extra])
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(golden)
+    if drop_eosl:
+        ours["Metadata"]["OS"].pop("EOSL", None)
+        want["Metadata"]["OS"].pop("EOSL", None)
+    assert ours == want
+
+
 def test_image_golden_alpine310(tmp_path, monkeypatch):
     """Full-report diff of an IMAGE scan against
     alpine-310.json.golden (round-3/4 ask: goldens had only ever
@@ -532,3 +569,60 @@ def test_image_golden_debian_buster(label, extra, golden_name,
     ours["Metadata"]["OS"].pop("EOSL", None)
     want["Metadata"]["OS"].pop("EOSL", None)
     assert ours == want
+
+
+DISTROLESS_OPENSSL = """\
+Package: libssl1.1
+Status: install ok installed
+Source: openssl
+Version: 1.1.0k-1~deb9u1
+Architecture: amd64
+
+Package: openssl
+Status: install ok installed
+Version: 1.1.0k-1~deb9u1
+Architecture: amd64
+"""
+
+
+def test_image_golden_distroless_base(tmp_path, monkeypatch):
+    """distroless-base golden: dpkg records live under
+    var/lib/dpkg/status.d/<pkg> (no monolithic status file), OS from
+    etc/os-release, postponed/unfixed debian advisories."""
+    os_release = (b'PRETTY_NAME="Distroless"\n'
+                  b'NAME="Debian GNU/Linux"\n'
+                  b'ID="debian"\nVERSION_ID="9"\n')
+    paras = DISTROLESS_OPENSSL.split("\n\n")
+    _run_image_golden(
+        tmp_path, monkeypatch, "distroless-base.tar.gz",
+        [{"etc/os-release": os_release,
+          "etc/debian_version": b"9.9\n",
+          "var/lib/dpkg/status.d/libssl": paras[0].encode() + b"\n",
+          "var/lib/dpkg/status.d/openssl":
+          paras[1].encode() + b"\n"}],
+        "distroless-base.json.golden")
+
+
+CARGO_LOCK = """\
+[[package]]
+name = "ammonia"
+version = "1.9.0"
+source = "registry+https://github.com/rust-lang/crates.io-index"
+
+[[package]]
+name = "app"
+version = "0.1.0"
+dependencies = [
+ "ammonia",
+]
+"""
+
+
+def test_image_golden_busybox_lockfile(tmp_path, monkeypatch):
+    """busybox-with-lockfile golden: a language lockfile inside an
+    image whose OS is unsupported — only the lang-pkgs result."""
+    _run_image_golden(
+        tmp_path, monkeypatch, "busybox-with-lockfile.tar.gz",
+        [{"bin/busybox": b"\x7fELF..."},
+         {"Cargo.lock": CARGO_LOCK.encode()}],
+        "busybox-with-lockfile.json.golden")

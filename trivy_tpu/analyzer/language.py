@@ -277,7 +277,10 @@ class CargoLockAnalyzer(Analyzer):
             data = tomllib.loads(content.decode("utf-8", "replace"))
         except tomllib.TOMLDecodeError:
             return None
-        pkgs = [_lib(p.get("name", ""), str(p.get("version", "")))
+        # no package ID: this reference vintage's cargo parser sets
+        # none (go-dep-parser cargo; busybox-with-lockfile golden
+        # carries no PkgID), unlike npm/yarn/pnpm
+        pkgs = [Package(name=p["name"], version=str(p["version"]))
                 for p in data.get("package", [])
                 if p.get("name") and p.get("version")]
         return _app("cargo", path, pkgs)
