@@ -626,3 +626,173 @@ def test_image_golden_busybox_lockfile(tmp_path, monkeypatch):
         [{"bin/busybox": b"\x7fELF..."},
          {"Cargo.lock": CARGO_LOCK.encode()}],
         "busybox-with-lockfile.json.golden")
+
+
+UBUNTU_1804_STATUS = """\
+Package: bash
+Status: install ok installed
+Version: 4.4.18-2ubuntu1.2
+Architecture: amd64
+
+Package: e2fsprogs
+Status: install ok installed
+Version: 1.44.1-1ubuntu1.1
+Architecture: amd64
+
+Package: libcom-err2
+Status: install ok installed
+Source: e2fsprogs
+Version: 1.44.1-1ubuntu1.1
+Architecture: amd64
+
+Package: libext2fs2
+Status: install ok installed
+Source: e2fsprogs
+Version: 1.44.1-1ubuntu1.1
+Architecture: amd64
+
+Package: libss2
+Status: install ok installed
+Source: e2fsprogs
+Version: 1.44.1-1ubuntu1.1
+Architecture: amd64
+"""
+
+UBUNTU_CASES = [
+    ("plain", [], "ubuntu-1804.json.golden"),
+    ("ignore-unfixed", ["--ignore-unfixed"],
+     "ubuntu-1804-ignore-unfixed.json.golden"),
+]
+
+
+@pytest.mark.parametrize("label,extra,golden_name", UBUNTU_CASES,
+                         ids=[c[0] for c in UBUNTU_CASES])
+def test_image_golden_ubuntu1804(label, extra, golden_name,
+                                 tmp_path, monkeypatch):
+    _run_image_golden(
+        tmp_path, monkeypatch, "ubuntu-1804.tar.gz",
+        [{"etc/lsb-release":
+          b"DISTRIB_ID=Ubuntu\nDISTRIB_RELEASE=18.04\n",
+          "var/lib/dpkg/status": UBUNTU_1804_STATUS.encode()}],
+        golden_name, extra=extra, drop_eosl=True)
+
+
+def _rpm_image_layers(release_file, release_text, headers):
+    from tests.test_rpm import make_bdb
+    return [{release_file: release_text,
+             "var/lib/rpm/Packages": make_bdb(headers)}]
+
+
+def test_image_golden_amazon2(tmp_path, monkeypatch):
+    """amazon-2: binary-name advisory keying, the '2 (Karoo)' OS
+    name with the bucket normalized to the bare stream."""
+    from tests.test_rpm import make_header
+    _run_image_golden(
+        tmp_path, monkeypatch, "amazon-2.tar.gz",
+        _rpm_image_layers(
+            "etc/system-release",
+            b"Amazon Linux release 2 (Karoo)\n",
+            [make_header("curl", "7.61.1", "9.amzn2.0.1",
+                         sourcerpm="curl-7.61.1-9.amzn2.0.1.src.rpm",
+                         vendor="Amazon Linux")]),
+        "amazon-2.json.golden", drop_eosl=True)
+
+
+def test_image_golden_almalinux8(tmp_path, monkeypatch):
+    from tests.test_rpm import make_header
+    _run_image_golden(
+        tmp_path, monkeypatch, "almalinux-8.tar.gz",
+        _rpm_image_layers(
+            "etc/almalinux-release",
+            b"AlmaLinux release 8.5 (Arctic Sphynx)\n",
+            [make_header("openssl-libs", "1.1.1k", "4.el8", epoch=1,
+                         sourcerpm="openssl-1.1.1k-4.el8.src.rpm",
+                         vendor="AlmaLinux")]),
+        "almalinux-8.json.golden")
+
+
+def test_image_golden_rockylinux8(tmp_path, monkeypatch):
+    from tests.test_rpm import make_header
+    _run_image_golden(
+        tmp_path, monkeypatch, "rockylinux-8.tar.gz",
+        _rpm_image_layers(
+            "etc/rocky-release",
+            b"Rocky Linux release 8.5 (Green Obsidian)\n",
+            [make_header("openssl-libs", "1.1.1k", "4.el8", epoch=1,
+                         sourcerpm="openssl-1.1.1k-4.el8.src.rpm",
+                         vendor="Rocky")]),
+        "rockylinux-8.json.golden")
+
+
+def test_image_golden_photon30(tmp_path, monkeypatch):
+    """photon-30: source-name lookup with binary EVR comparison
+    (curl-libs resolves through source curl)."""
+    from tests.test_rpm import make_header
+    os_release = (b'NAME="VMware Photon OS"\nVERSION="3.0"\n'
+                  b'ID=photon\nVERSION_ID=3.0\n')
+    _run_image_golden(
+        tmp_path, monkeypatch, "photon-30.tar.gz",
+        _rpm_image_layers(
+            "etc/os-release", os_release,
+            [make_header("bash", "4.4.18", "1.ph3",
+                         sourcerpm="bash-4.4.18-1.ph3.src.rpm",
+                         vendor="VMware, Inc."),
+             make_header("curl", "7.61.1", "4.ph3",
+                         sourcerpm="curl-7.61.1-4.ph3.src.rpm",
+                         vendor="VMware, Inc."),
+             make_header("curl-libs", "7.61.1", "4.ph3",
+                         sourcerpm="curl-7.61.1-4.ph3.src.rpm",
+                         vendor="VMware, Inc.")]),
+        "photon-30.json.golden", drop_eosl=True)
+
+
+def test_image_golden_mariner10(tmp_path, monkeypatch):
+    """mariner-1.0: the distroless rpmqa manifest (no BDB, no
+    package IDs), version trimmed to major.minor, source-name
+    lookup, epoch-0 dropped from the reported FixedVersion."""
+    os_release = (b'NAME="CBL-Mariner/Linux"\n'
+                  b'VERSION="1.0.20220122"\nID=mariner\n'
+                  b'VERSION_ID=1.0.20220122\n')
+    manifest = ("vim\t8.2.4081-1.cm1\t0\t0\t"
+                "Microsoft Corporation\t(none)\t3565979\tx86_64\t0\t"
+                "vim-8.2.4081-1.cm1.src.rpm\n")
+    _run_image_golden(
+        tmp_path, monkeypatch, "mariner-1.0.tar.gz",
+        [{"etc/os-release": os_release,
+          "var/lib/rpmmanifest/container-manifest-2":
+          manifest.encode()}],
+        "mariner-1.0.json.golden")
+
+
+def test_image_golden_opensuse_leap151(tmp_path, monkeypatch):
+    from tests.test_rpm import make_header
+    os_release = (b'NAME="openSUSE Leap"\nVERSION="15.1"\n'
+                  b'ID="opensuse-leap"\nVERSION_ID="15.1"\n')
+    _run_image_golden(
+        tmp_path, monkeypatch, "opensuse-leap-151.tar.gz",
+        _rpm_image_layers(
+            "etc/os-release", os_release,
+            [make_header("libopenssl1_1", "1.1.0i", "lp151.8.3.1",
+                         sourcerpm="openssl-1_1-1.1.0i-"
+                         "lp151.8.3.1.src.rpm",
+                         vendor="SUSE LLC"),
+             make_header("openssl-1_1", "1.1.0i", "lp151.8.3.1",
+                         sourcerpm="openssl-1_1-1.1.0i-"
+                         "lp151.8.3.1.src.rpm",
+                         vendor="SUSE LLC")]),
+        "opensuse-leap-151.json.golden")
+
+
+def test_image_golden_amazon1(tmp_path, monkeypatch):
+    """amazon-1: the AL1 release line keeps its full suffix as the
+    OS name ("AMI release 2018.03") and buckets under stream 1."""
+    from tests.test_rpm import make_header
+    _run_image_golden(
+        tmp_path, monkeypatch, "amazon-1.tar.gz",
+        _rpm_image_layers(
+            "etc/system-release",
+            b"Amazon Linux AMI release 2018.03\n",
+            [make_header("curl", "7.61.1", "11.91.amzn1",
+                         sourcerpm="curl-7.61.1-11.91.amzn1.src.rpm",
+                         vendor="Amazon.com, Inc.")]),
+        "amazon-1.json.golden", drop_eosl=True)

@@ -226,7 +226,13 @@ class TestAmazonLinux2022:
         assert a.required("usr/lib/system-release")
         r = a.analyze("usr/lib/system-release",
                       b"Amazon Linux release 2022 (Amazon Linux)\n")
-        assert (r.os.family, r.os.name) == ("amazon", "2022")
+        # full name kept (ref amazonlinux.go:50-58); the driver
+        # normalizes the bucket stream from the first field
+        assert (r.os.family, r.os.name) == \
+            ("amazon", "2022 (Amazon Linux)")
+        from trivy_tpu.detect.ospkg.drivers import DRIVERS
+        assert DRIVERS["amazon"].bucket(r.os.name, None) == \
+            "amazon linux 2022"
 
 
 class TestSysfileFilter:

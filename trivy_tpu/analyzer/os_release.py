@@ -157,6 +157,21 @@ class RedHatBaseAnalyzer(Analyzer):
                     break
         if family is None:
             return None
+        if family == "amazon":
+            # the full suffix is the name (ref amazonlinux.go
+            # parseRelease): "Amazon Linux release 2 (Karoo)" →
+            # "2 (Karoo)"; AL1 "Amazon Linux AMI release 2018.03"
+            # → "AMI release 2018.03" (fields[2:])
+            first = text.splitlines()[0]
+            fields = first.split()
+            if first.startswith("Amazon Linux release 2") and \
+                    len(fields) >= 5:
+                return AnalysisResult(os=OS(
+                    family="amazon", name=" ".join(fields[3:])))
+            if first.startswith("Amazon Linux") and \
+                    len(fields) > 2:
+                return AnalysisResult(os=OS(
+                    family="amazon", name=" ".join(fields[2:])))
         m = _VERSION_RE.search(text)
         name = m.group(1) if m else ""
         return AnalysisResult(os=OS(family=family, name=name))

@@ -116,8 +116,10 @@ class RpmQaAnalyzer(Analyzer):
                             epoch=epoch, arch=arch,
                             source_rpm=source_rpm)
             src_name, src_ver, src_rel = rp.src_fields
+            # no package ID: the reference's rpmqa parser sets none
+            # (go-dep-parser rpmqa; mariner-1.0 golden carries no
+            # PkgID), unlike the rpmdb analyzer
             pkgs.append(Package(
-                id=f"{name}@{ver}-{rel}.{arch}",
                 name=name, version=ver, release=rel, epoch=epoch,
                 arch=arch, src_name=src_name, src_version=src_ver,
                 src_release=src_rel, src_epoch=epoch))

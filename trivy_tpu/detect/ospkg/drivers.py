@@ -60,6 +60,11 @@ class Driver:
         Default: the per-advisory arch lists."""
         return arch_match(pkg, adv)
 
+    def fixed_version(self, adv) -> str:
+        """Reported FixedVersion; drivers that normalize it through
+        their version grammar override (mariner.go:68-70)."""
+        return adv.fixed_version
+
     # --- main loop (mirrors e.g. debian.go:85-140) ---
 
     def detect(self, store, os_ver: str, repo, pkgs: list) -> list:
@@ -85,7 +90,7 @@ class Driver:
                     pkg_id=pkg.id,
                     pkg_name=pkg.name,
                     installed_version=installed,
-                    fixed_version=adv.fixed_version,
+                    fixed_version=self.fixed_version(adv),
                     layer=pkg.layer,
                     ref=pkg.ref,
                     data_source=adv.data_source,
@@ -348,14 +353,78 @@ class _RedHat(Driver):
         return os_ver.split(".")[0]
 
 
-class _Amazon(_MajorOnly):
+class _BinaryKeyed(Driver):
+    """Families whose advisories key by BINARY package name and
+    compare binary EVR (amazon.go:77,82; alma.go:76,82;
+    rocky.go:76,82) — unlike debian/ubuntu/mariner which use the
+    source package."""
+
+    def src_name(self, pkg) -> str:
+        return add_modular_namespace(pkg.name,
+                                     pkg.modularity_label) \
+            if pkg.modularity_label else pkg.name
+
+    def installed(self, pkg) -> str:
+        return format_version(pkg.epoch, pkg.version, pkg.release)
+
+
+class _AlmaRocky(_BinaryKeyed):
+    """Alma/Rocky: major-only bucket, and packages built from a
+    module but missing their modularity label cannot be looked up
+    safely — skipped (alma.go:72-75, rocky.go:72-75)."""
+
+    def normalize_ver(self, os_ver: str) -> str:
+        return os_ver.split(".")[0]
+
+    def adv_match(self, os_ver: str, pkg, adv) -> bool:
+        if ".module_el" in pkg.release and \
+                not pkg.modularity_label:
+            return False
+        return super().adv_match(os_ver, pkg, adv)
+
+
+class _SrcNameBinaryVer(Driver):
+    """photon/suse: source-name bucket lookup but BINARY EVR
+    comparison (photon.go:69,74; suse.go:121,126)."""
+
+    def installed(self, pkg) -> str:
+        return format_version(pkg.epoch, pkg.version, pkg.release)
+
+
+class _Amazon(_BinaryKeyed):
+    def src_name(self, pkg) -> str:
+        # plain binary name — amazon.go:77 has no modular-namespace
+        # handling, unlike alma/rocky/redhat
+        return pkg.name
+
     def eol_key(self, os_ver: str) -> str:
-        # amazon.go:121-124: first field; anything that isn't a known
-        # stream is Amazon Linux 1 ("2018.03" etc.)
+        # amazon.go:121-124: first field; anything that isn't
+        # stream 2 is Amazon Linux 1 ("2018.03" etc.)
         ver = os_ver.split()[0] if os_ver.split() else os_ver
-        if ver not in self.eol:
-            ver = "1" if ver != "2" else ver
-        return ver
+        return ver if ver == "2" else "1"
+
+    def normalize_ver(self, os_ver: str) -> str:
+        # bucket stream (amazon.go:68-71): the OS name carries the
+        # codename ("2 (Karoo)", "2022 (Amazon Linux)"); streams
+        # other than 2/2022 are Amazon Linux 1
+        ver = os_ver.split()[0] if os_ver.split() else os_ver
+        return ver if ver in ("2", "2022") else "1"
+
+
+class _Mariner(Driver):
+    """CBL-Mariner (ref pkg/detector/ospkg/mariner): version
+    trimmed to major.minor ("1.0.20220122" → "1.0"), source
+    package names, and FixedVersion normalized through the rpm
+    grammar — a 0 epoch is dropped (mariner.go:33-35,68-70)."""
+
+    def normalize_ver(self, os_ver: str) -> str:
+        if os_ver.count(".") > 1:
+            return os_ver[:os_ver.rindex(".")]
+        return os_ver
+
+    def fixed_version(self, adv) -> str:
+        v = adv.fixed_version
+        return v[2:] if v.startswith("0:") else v
 
 
 DRIVERS = {
@@ -372,10 +441,10 @@ DRIVERS = {
                       report_unfixed=False, eol=AMAZON_EOL),
     "oracle": _MajorOnly("oracle", "rpm", "Oracle Linux {ver}",
                          report_unfixed=False, eol=ORACLE_EOL),
-    "alma": _MajorOnly("alma", "rpm", "alma {ver}",
+    "alma": _AlmaRocky("alma", "rpm", "alma {ver}",
                        severity_source="alma", report_unfixed=False,
                        eol=ALMA_EOL),
-    "rocky": _MajorOnly("rocky", "rpm", "rocky {ver}",
+    "rocky": _AlmaRocky("rocky", "rpm", "rocky {ver}",
                         severity_source="rocky", report_unfixed=False,
                         eol=ROCKY_EOL),
     "redhat": _RedHat("redhat", "rpm", "Red Hat",
@@ -384,15 +453,21 @@ DRIVERS = {
     "centos": _RedHat("centos", "rpm", "Red Hat",
                       severity_source="redhat", report_unfixed=True,
                       eol=CENTOS_EOL),
-    "cbl-mariner": Driver("cbl-mariner", "rpm", "CBL-Mariner {ver}",
-                          report_unfixed=True),
-    "photon": Driver("photon", "rpm", "Photon OS {ver}",
-                     severity_source="photon", report_unfixed=True,
-                     eol=PHOTON_EOL),
-    "opensuse.leap": Driver("opensuse.leap", "rpm",
-                            "openSUSE Leap {ver}",
-                            report_unfixed=False, eol=OPENSUSE_EOL),
-    "suse linux enterprise server": Driver(
+    "cbl-mariner": _Mariner("cbl-mariner", "rpm",
+                            "CBL-Mariner {ver}",
+                            report_unfixed=True),
+    # photon.go has no unfixed-advisory branch: an empty
+    # FixedVersion never satisfies LessThan, so unfixed photon
+    # entries are dropped by the reference — report_unfixed=False
+    "photon": _SrcNameBinaryVer("photon", "rpm",
+                                "Photon OS {ver}",
+                                severity_source="photon",
+                                report_unfixed=False,
+                                eol=PHOTON_EOL),
+    "opensuse.leap": _SrcNameBinaryVer(
+        "opensuse.leap", "rpm", "openSUSE Leap {ver}",
+        report_unfixed=False, eol=OPENSUSE_EOL),
+    "suse linux enterprise server": _SrcNameBinaryVer(
         "suse linux enterprise server", "rpm",
         "SUSE Linux Enterprise {ver}", report_unfixed=False,
         eol=SLES_EOL),

@@ -259,7 +259,7 @@ class LocalScanner:
             pkg_id=pkg.id,
             pkg_name=pkg.name,
             installed_version=installed,
-            fixed_version=adv.fixed_version,
+            fixed_version=driver.fixed_version(adv),
             layer=pkg.layer,
             ref=pkg.ref,
             data_source=adv.data_source,
