@@ -796,3 +796,59 @@ def test_image_golden_amazon1(tmp_path, monkeypatch):
                          sourcerpm="curl-7.61.1-11.91.amzn1.src.rpm",
                          vendor="Amazon.com, Inc.")]),
         "amazon-1.json.golden", drop_eosl=True)
+
+
+def test_image_golden_ubi7(tmp_path, monkeypatch):
+    """ubi-7: a Red Hat layered image — advisories narrow through
+    the root/buildinfo content manifest's repositories via the
+    "Red Hat CPE" index mapping (repository rhel-7-server-rpms →
+    CPE 869, which the bash advisory entry carries)."""
+    import json as _json
+    from tests.test_rpm import make_bdb, make_header
+    manifest = _json.dumps(
+        {"content_sets": ["rhel-7-server-rpms",
+                          "rhel-7-server-extras-rpms"]})
+    _run_image_golden(
+        tmp_path, monkeypatch, "ubi-7.tar.gz",
+        [{"etc/redhat-release":
+          b"Red Hat Enterprise Linux Server release 7.7 (Maipo)\n",
+          "root/buildinfo/content_manifests/ubi7.json":
+          manifest.encode(),
+          "var/lib/rpm/Packages": make_bdb([
+              make_header("bash", "4.2.46", "33.el7",
+                          sourcerpm="bash-4.2.46-33.el7.src.rpm",
+                          vendor="Red Hat, Inc.")])}],
+        "ubi-7.json.golden")
+
+
+def test_image_golden_centos6(tmp_path, monkeypatch):
+    """centos-6: default content sets for major 6
+    (rhel-6-server-rpms → CPE 857 selects RHSA-2019:2471, the el6
+    fix), a 0 epoch stripped from the reported FixedVersion, and an
+    unfixed glibc advisory."""
+    from tests.test_rpm import make_bdb, make_header
+    _run_image_golden(
+        tmp_path, monkeypatch, "centos-6.tar.gz",
+        [{"etc/centos-release":
+          b"CentOS release 6.10 (Final)\n",
+          "var/lib/rpm/Packages": make_bdb([
+              make_header("glibc", "2.12", "1.212.el6",
+                          sourcerpm="glibc-2.12-1.212.el6.src.rpm"),
+              make_header("openssl", "1.0.1e", "57.el6",
+                          sourcerpm="openssl-1.0.1e-57.el6"
+                          ".src.rpm")])}],
+        "centos-6.json.golden", drop_eosl=False)
+
+
+def test_image_golden_oraclelinux8(tmp_path, monkeypatch):
+    """oraclelinux-8: binary keying with the ksplice gate."""
+    from tests.test_rpm import make_header
+    _run_image_golden(
+        tmp_path, monkeypatch, "oraclelinux-8.tar.gz",
+        _rpm_image_layers(
+            "etc/oracle-release",
+            b"Oracle Linux Server release 8.0\n",
+            [make_header("curl", "7.61.1", "8.el8",
+                         sourcerpm="curl-7.61.1-8.el8.src.rpm",
+                         vendor="Oracle America")]),
+        "oraclelinux-8.json.golden")

@@ -352,6 +352,10 @@ class _RedHat(Driver):
         # "8.4.2105" → "8" (redhat.go:212-214)
         return os_ver.split(".")[0]
 
+    def fixed_version(self, adv) -> str:
+        # redhat.go:184 fixedVersion.String()
+        return _strip_zero_epoch(adv.fixed_version)
+
 
 class _BinaryKeyed(Driver):
     """Families whose advisories key by BINARY package name and
@@ -368,17 +372,42 @@ class _BinaryKeyed(Driver):
         return format_version(pkg.epoch, pkg.version, pkg.release)
 
 
-class _AlmaRocky(_BinaryKeyed):
+class _AlmaRocky(_MajorOnly, _BinaryKeyed):
     """Alma/Rocky: major-only bucket, and packages built from a
     module but missing their modularity label cannot be looked up
     safely — skipped (alma.go:72-75, rocky.go:72-75)."""
 
-    def normalize_ver(self, os_ver: str) -> str:
-        return os_ver.split(".")[0]
-
     def adv_match(self, os_ver: str, pkg, adv) -> bool:
         if ".module_el" in pkg.release and \
                 not pkg.modularity_label:
+            return False
+        return super().adv_match(os_ver, pkg, adv)
+
+
+def _strip_zero_epoch(v: str) -> str:
+    """rpm-grammar FixedVersion normalization: Version.String()
+    omits a 0 epoch (redhat.go:184, mariner.go:68-70)."""
+    return v[2:] if v.startswith("0:") else v
+
+
+def _ksplice(v: str) -> str:
+    """The 'kspliceN' dot-component of a version/release, or ""
+    (oracle.go extractKsplice)."""
+    for part in v.split("."):
+        if part.startswith("ksplice"):
+            return part
+    return ""
+
+
+class _Oracle(_MajorOnly, _BinaryKeyed):
+    """Oracle Linux: major-only bucket, binary keying, and a
+    ksplice gate — an advisory only applies when its fixed
+    version's ksplice component matches the package release's
+    (oracle.go:78-82). FixedVersion is reported verbatim
+    (oracle.go:97)."""
+
+    def adv_match(self, os_ver: str, pkg, adv) -> bool:
+        if _ksplice(adv.fixed_version) != _ksplice(pkg.release):
             return False
         return super().adv_match(os_ver, pkg, adv)
 
@@ -398,8 +427,11 @@ class _Amazon(_BinaryKeyed):
         return pkg.name
 
     def eol_key(self, os_ver: str) -> str:
-        # amazon.go:121-124: first field; anything that isn't
-        # stream 2 is Amazon Linux 1 ("2018.03" etc.)
+        # amazon.go IsSupportedVersion: anything that isn't stream
+        # 2 maps to Amazon Linux 1 — INCLUDING 2022, whose eolDates
+        # entry (year 3000) is unreachable in the reference too;
+        # AL2022 is therefore reported end-of-support, quirk kept
+        # for parity (amazon.go:21-26,121-126)
         ver = os_ver.split()[0] if os_ver.split() else os_ver
         return ver if ver == "2" else "1"
 
@@ -423,8 +455,8 @@ class _Mariner(Driver):
         return os_ver
 
     def fixed_version(self, adv) -> str:
-        v = adv.fixed_version
-        return v[2:] if v.startswith("0:") else v
+        # mariner.go:68-70 fixedVersion.String()
+        return _strip_zero_epoch(adv.fixed_version)
 
 
 DRIVERS = {
@@ -439,8 +471,8 @@ DRIVERS = {
     "amazon": _Amazon("amazon", "rpm", "amazon linux {ver}",
                       severity_source="amazon",
                       report_unfixed=False, eol=AMAZON_EOL),
-    "oracle": _MajorOnly("oracle", "rpm", "Oracle Linux {ver}",
-                         report_unfixed=False, eol=ORACLE_EOL),
+    "oracle": _Oracle("oracle", "rpm", "Oracle Linux {ver}",
+                      report_unfixed=False, eol=ORACLE_EOL),
     "alma": _AlmaRocky("alma", "rpm", "alma {ver}",
                        severity_source="alma", report_unfixed=False,
                        eol=ALMA_EOL),
