@@ -852,3 +852,47 @@ def test_image_golden_oraclelinux8(tmp_path, monkeypatch):
                          sourcerpm="curl-7.61.1-8.el8.src.rpm",
                          vendor="Oracle America")]),
         "oraclelinux-8.json.golden")
+
+
+def test_image_golden_fluentd_gems(tmp_path, monkeypatch):
+    """fluentd-gems: installed gem specifications aggregate into
+    the synthetic "Ruby" target with per-package PkgPath, next to
+    the os-pkgs result from the same image."""
+    gemspec = b'''# -*- encoding: utf-8 -*-
+Gem::Specification.new do |s|
+  s.name = "activesupport".freeze
+  s.version = "6.0.2.1"
+  s.summary = "Support and utility classes.".freeze
+end
+'''
+    status = (b"Package: libidn2-0\n"
+              b"Status: install ok installed\n"
+              b"Source: libidn2\n"
+              b"Version: 2.0.5-1\n"
+              b"Architecture: amd64\n")
+    _run_image_golden(
+        tmp_path, monkeypatch,
+        "fluentd-multiple-lockfiles.tar.gz",
+        [{"etc/debian_version": b"10.2\n",
+          "var/lib/dpkg/status": status,
+          "var/lib/gems/2.5.0/specifications/"
+          "activesupport-6.0.2.1.gemspec": gemspec}],
+        "fluentd-gems.json.golden", drop_eosl=True)
+
+
+def test_image_golden_alpine_distroless(tmp_path, monkeypatch):
+    """alpine-distroless: the OS is 3.16 (os-release) but the apk
+    repositories file points at edge — the repository release wins
+    the advisory bucket (alpine.go:96-104), selecting the git
+    advisory stored under "alpine edge"."""
+    os_release = (b'ID=alpine\nNAME="Alpine Linux"\n'
+                  b'VERSION_ID=3.16\n')
+    repos = (b"https://dl-cdn.alpinelinux.org/alpine/edge/main\n")
+    installed = (b"P:git\nV:2.35.1-r2\nA:x86_64\no:git\n"
+                 b"L:GPL-2.0-only\n\n")
+    _run_image_golden(
+        tmp_path, monkeypatch, "alpine-distroless.tar.gz",
+        [{"etc/os-release": os_release,
+          "etc/apk/repositories": repos,
+          "lib/apk/db/installed": installed}],
+        "alpine-distroless.json.golden", drop_eosl=True)

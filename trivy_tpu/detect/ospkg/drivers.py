@@ -392,8 +392,8 @@ def _strip_zero_epoch(v: str) -> str:
 
 def _ksplice(v: str) -> str:
     """The 'kspliceN' dot-component of a version/release, or ""
-    (oracle.go extractKsplice)."""
-    for part in v.split("."):
+    (oracle.go extractKsplice lowercases before splitting)."""
+    for part in v.lower().split("."):
         if part.startswith("ksplice"):
             return part
     return ""
@@ -405,6 +405,11 @@ class _Oracle(_MajorOnly, _BinaryKeyed):
     version's ksplice component matches the package release's
     (oracle.go:78-82). FixedVersion is reported verbatim
     (oracle.go:97)."""
+
+    def src_name(self, pkg) -> str:
+        # plain binary name — oracle.go:77 has no modular-namespace
+        # handling, unlike alma/rocky/redhat
+        return pkg.name
 
     def adv_match(self, os_ver: str, pkg, adv) -> bool:
         if _ksplice(adv.fixed_version) != _ksplice(pkg.release):

@@ -88,11 +88,16 @@ class LocalScanner:
             detail.packages.extend(
                 p for p in history if p.name not in present)
 
-        if detail.os is None and detail.packages:
-            detail.os = OS(family="none")
+        # repository fallback BEFORE the "none" default — a
+        # distroless alpine has packages and an apk repositories
+        # stream but no release file (ref local/scan.go:82-97,
+        # where the Repository assignment overwrites the "none"
+        # default unconditionally)
         if detail.os is None and detail.repository is not None:
             detail.os = OS(family=detail.repository.family,
                            name=detail.repository.release)
+        if detail.os is None and detail.packages:
+            detail.os = OS(family="none")
 
         pkg_results: list = []
         if options.list_all_packages:
