@@ -234,7 +234,8 @@ def _norm_image(o):
         if isinstance(x, list):
             return [strip_layers(v) for v in x]
         return x
-    o["Results"] = strip_layers(o["Results"])
+    if "Results" in o:
+        o["Results"] = strip_layers(o["Results"])
     return o
 
 
@@ -301,6 +302,8 @@ def test_image_golden_alpine310(tmp_path, monkeypatch):
 
 ALPINE39_CASES = [
     ("plain", [], "alpine-39.json.golden"),
+    ("skip-dirs", ["--skip-dirs", "/etc"],
+     "alpine-39-skip.json.golden"),
     ("high-critical",
      ["--severity", "HIGH,CRITICAL", "--ignore-unfixed"],
      "alpine-39-high-critical.json.golden"),

@@ -14,8 +14,11 @@ from .common import Code, Layer, asdict_omitempty, jfield
 
 @dataclass
 class OS:
-    family: str = jfield("Family", default="")
-    name: str = jfield("Name", default="")
+    # Family/Name marshal unconditionally — no omitempty on either
+    # (ref fanal/types/artifact.go:9-13); alpine-39-skip.json.golden
+    # carries {"Family": "none", "Name": ""}
+    family: str = jfield("Family", default="", keep=True)
+    name: str = jfield("Name", default="", keep=True)
     # ref fanal/types/artifact.go:12 — tag is EOSL, not Eosl
     eosl: bool = jfield("EOSL", default=False)
     extended: bool = jfield("Extended", default=False)
