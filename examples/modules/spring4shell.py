@@ -1,43 +1,90 @@
-"""Example extension module (the analog of the reference's
-examples/module/spring4shell WASM module).
+"""Spring4Shell extension module — the Python analog of the
+reference's examples/module/spring4shell WASM module
+(spring4shell.go), logic ported behavior for behavior.
 
-Drop into ~/.trivy-tpu/modules/ to activate: flags Spring4Shell
-(CVE-2022-22965) exposure by spotting vulnerable spring-beans usage
-in scanned jars and rewriting the severity of matching findings.
+Drop into ~/.trivy-tpu/modules/ (or `trivy-tpu module install`) to
+activate. The analyzer half records the image's Java major version
+(openjdk/jdk release files) and Tomcat version (RELEASE-NOTES) as
+custom resources; the post-scan half downgrades CVE-2022-22965 from
+CRITICAL to LOW when the deployment cannot be exploited: JDK 8 or
+older, a patched Tomcat, or the vulnerable jar not deployed as a
+.war (spring4shell.go:230-284).
 """
+
+import re
 
 name = "spring4shell"
 version = 1
 api_version = 1
 is_analyzer = True
 is_post_scanner = True
-required_files = [r"\.jar$"]
+required_files = [
+    r"/openjdk-\d+/release",   # OpenJDK version
+    r"/jdk\d+/release",        # JDK version
+    r"tomcat/RELEASE-NOTES",   # Tomcat version
+]
 
 VULN_ID = "CVE-2022-22965"
+TYPE_JAVA_MAJOR = "spring4shell/java-major-version"
+TYPE_TOMCAT = "spring4shell/tomcat-version"
 
-
-# jars where the analyzer saw spring-beans evidence this process
-_EVIDENCE = set()
+_TOMCAT_RE = re.compile(r"Apache Tomcat Version ([\d.]+)")
+# fixed Tomcat releases (spring4shell.go:263: "TODO: version
+# comparison" — the reference checks exact strings, kept as-is)
+_TOMCAT_FIXED = ("10.0.20", "9.0.62", "8.5.78")
 
 
 def analyze(path, content):
-    # a real module would inspect the jar's JDK target; the example
-    # records which jars bundle spring-beans
-    if b"spring-beans" in content or b"CachedIntrospectionResults" \
-            in content:
-        _EVIDENCE.add(path)
-        return {"spring_beans": True, "path": path}
+    text = content.decode("utf-8", "replace")
+    if path.endswith("/release"):
+        for line in text.splitlines():
+            if line.startswith("JAVA_VERSION="):
+                return {"type": TYPE_JAVA_MAJOR,
+                        "data": line.split("=", 1)[1].strip('"')}
+        return None
+    if path.endswith("/RELEASE-NOTES"):
+        m = _TOMCAT_RE.search(text)
+        if m:
+            return {"type": TYPE_TOMCAT, "data": m.group(1)}
     return None
 
 
+def _java_major(v):
+    """"1.8.0_322" → 8; "11.0.14.1" → 11 (spring4shell.go:236-248)."""
+    parts = v.split(".")
+    if len(parts) < 2:
+        return 0
+    ver = parts[1] if parts[0] == "1" else parts[0]
+    try:
+        return int(ver)
+    except ValueError:
+        return 0
+
+
 def post_scan(results):
-    """Raise Spring4Shell to CRITICAL only when the analyzer saw
-    evidence of an exploitable deployment (the reference's example
-    DELETEs or UPDATEs findings the same way)."""
-    if not _EVIDENCE:
-        return results
+    java_major = 0
+    tomcat = ""
     for r in results:
-        for v in r.vulnerabilities:
-            if v.vulnerability_id == VULN_ID:
-                v.vulnerability.severity = "CRITICAL"
+        if getattr(r, "class_", "") != "custom":
+            continue
+        for c in r.custom_resources:
+            if c.type == TYPE_JAVA_MAJOR:
+                java_major = _java_major(str(c.data))
+            elif c.type == TYPE_TOMCAT:
+                tomcat = str(c.data)
+
+    vulnerable = True
+    if tomcat in _TOMCAT_FIXED:
+        vulnerable = False
+    elif java_major <= 8:
+        vulnerable = False
+
+    for r in results:
+        for v in getattr(r, "vulnerabilities", []):
+            if v.vulnerability_id != VULN_ID:
+                continue
+            # substring, not suffix — spring4shell.go:278 uses
+            # strings.Contains(vuln.PkgPath, ".war")
+            if ".war" not in v.pkg_path or not vulnerable:
+                v.vulnerability.severity = "LOW"
     return results

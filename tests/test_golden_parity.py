@@ -899,3 +899,103 @@ def test_image_golden_alpine_distroless(tmp_path, monkeypatch):
           "etc/apk/repositories": repos,
           "lib/apk/db/installed": installed}],
         "alpine-distroless.json.golden", drop_eosl=True)
+
+
+def _spring4shell_tar(tmp_path, tar_name, golden, java_release):
+    """debian 11.3 tomcat image with a .war bundling
+    spring-beans-5.3.15 plus the jdk release / tomcat notes files
+    the spring4shell module reads."""
+    import io as _io
+    import zipfile as _zip
+    from trivy_tpu.utils.synth import write_image_tar
+
+    def _zipbytes(entries):
+        buf = _io.BytesIO()
+        with _zip.ZipFile(buf, "w") as zf:
+            for name, data in entries.items():
+                zf.writestr(name, data)
+        return buf.getvalue()
+
+    inner = _zipbytes({
+        "META-INF/maven/org.springframework/spring-beans/"
+        "pom.properties":
+        b"groupId=org.springframework\n"
+        b"artifactId=spring-beans\nversion=5.3.15\n"})
+    war = _zipbytes({"WEB-INF/lib/spring-beans-5.3.15.jar": inner})
+    status = (b"Package: base-files\n"
+              b"Status: install ok installed\n"
+              b"Version: 11.1+deb11u3\n"
+              b"Architecture: amd64\n")
+    out_dir = os.path.join(str(tmp_path), "testdata", "fixtures",
+                           "images")
+    os.makedirs(out_dir, exist_ok=True)
+    write_image_tar(
+        os.path.join(out_dir, tar_name),
+        [{"etc/debian_version": b"11.3\n",
+          "var/lib/dpkg/status": status,
+          java_release[0]: java_release[1],
+          "usr/local/tomcat/RELEASE-NOTES":
+          b"  Apache Tomcat Version 8.5.77\n",
+          "usr/local/tomcat/webapps/helloworld.war": war}],
+        config=golden["Metadata"]["ImageConfig"], gzipped=True)
+
+
+SPRING4SHELL_CASES = [
+    ("jre8",
+     ("usr/local/openjdk-8/release",
+      b'JAVA_VERSION="1.8.0_322"\n'),
+     "spring4shell-jre8.json.golden"),
+    ("jre11",
+     ("usr/local/openjdk-11/release",
+      b'JAVA_VERSION="11.0.14.1"\n'),
+     "spring4shell-jre11.json.golden"),
+]
+
+
+@pytest.mark.parametrize("label,java_release,golden_name",
+                         SPRING4SHELL_CASES,
+                         ids=[c[0] for c in SPRING4SHELL_CASES])
+def test_image_golden_spring4shell(label, java_release, golden_name,
+                                   tmp_path, monkeypatch):
+    """The module pipeline end-to-end (ref integration/
+    module_test.go): the spring4shell module's analyzer records the
+    Java/Tomcat versions as custom resources and its post-scanner
+    downgrades CVE-2022-22965 to LOW on JDK 8; the custom result
+    survives as an empty husk, as does the finding-free os-pkgs
+    result."""
+    import shutil
+    from trivy_tpu import cli
+    golden = json.load(open(os.path.join(
+        REF, "testdata", golden_name)))
+    tar_name = f"spring4shell-{label}.tar.gz"
+    _spring4shell_tar(tmp_path, tar_name, golden, java_release)
+    moddir = tmp_path / "modules"
+    moddir.mkdir()
+    shutil.copy(os.path.join(os.path.dirname(__file__), "..",
+                             "examples", "modules",
+                             "spring4shell.py"),
+                moddir / "spring4shell.py")
+    monkeypatch.setenv("TRIVY_MODULE_DIR", str(moddir))
+    db = _db_paths()
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "image", "--input",
+        f"testdata/fixtures/images/{tar_name}",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--security-checks", "vuln",
+        "--db-fixtures", db])
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(golden)
+    # the reference's WASM serialize round-trip drops the dates from
+    # module-updated findings (updateResults replaces Vulnerability
+    # with the guest's copy); our in-process module pipeline is
+    # lossless, so normalize the two date fields
+    for o in (ours, want):
+        for r in o.get("Results") or []:
+            for v in r.get("Vulnerabilities") or []:
+                v.pop("PublishedDate", None)
+                v.pop("LastModifiedDate", None)
+    assert ours == want

@@ -97,8 +97,10 @@ class TestExampleModule:
         mods = Manager(str(mod_dir)).load()
         assert [m.name for m in mods] == ["spring4shell"]
         assert mods[0].analyze(
-            "x.jar", b"...spring-beans...") == {
-                "spring_beans": True, "path": "x.jar"}
+            "/usr/local/openjdk-11/release",
+            b'JAVA_VERSION="11.0.14.1"\n') == {
+                "type": "spring4shell/java-major-version",
+                "data": "11.0.14.1"}
 
     def test_discover_sbom(self, fake_rekor):
         """The attestation-discovery integration point decodes a

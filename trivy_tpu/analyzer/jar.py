@@ -90,7 +90,15 @@ def _read_entry(zf, entry, path):
 
 
 def _scan_zip(path: str, data: bytes, depth: int,
-              pkgs: list, seen: set) -> None:
+              pkgs: list, seen: set,
+              top_path: str = "") -> None:
+    """``top_path`` is the file the walker saw; every package —
+    including ones found in nested jars — reports it as FilePath
+    (ref analyzer/language/java/jar passes input.FilePath to the
+    parser for the whole tree; spring4shell goldens carry the .war
+    path for the nested spring-beans jar). ``path`` tracks the
+    nesting chain for identity-from-filename and logging."""
+    top_path = top_path or path
     try:
         zf = zipfile.ZipFile(io.BytesIO(data))
     except _ZIP_ERRORS as e:
@@ -117,7 +125,7 @@ def _scan_zip(path: str, data: bytes, depth: int,
                         seen.add(key)
                         pkgs.append(Package(
                             name=key[0], version=version,
-                            file_path=path))
+                            file_path=top_path))
         if not found_pom:
             identity = None
             if "META-INF/MANIFEST.MF" in names:
@@ -129,7 +137,7 @@ def _scan_zip(path: str, data: bytes, depth: int,
                 seen.add(identity)
                 pkgs.append(Package(name=identity[0],
                                     version=identity[1],
-                                    file_path=path))
+                                    file_path=top_path))
         if depth < MAX_NESTED_DEPTH:
             for entry in names:
                 if entry.endswith(_EXTS):
@@ -137,7 +145,8 @@ def _scan_zip(path: str, data: bytes, depth: int,
                     if inner is None:
                         continue
                     _scan_zip(f"{path}!{entry}", inner,
-                              depth + 1, pkgs, seen)
+                              depth + 1, pkgs, seen,
+                              top_path=top_path)
 
 
 @register_analyzer

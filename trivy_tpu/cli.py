@@ -808,7 +808,11 @@ def _finish(args, report: Report) -> int:
         # Rego eval errors; unrelated bugs keep their traceback
         print(f"error: ignore policy failed: {e}", file=sys.stderr)
         return 1
-    report.results = [r for r in results if not r.empty()]
+    # the reference never drops emptied results — a filtered-out or
+    # finding-free result stays as a husk (filter.go mutates in
+    # place; spring4shell-*.json.golden keep the empty os-pkgs and
+    # custom entries)
+    report.results = results
     out = open(args.output, "w") if args.output else sys.stdout
     try:
         write_report(report, fmt=args.format, output=out,

@@ -66,10 +66,20 @@ class _ModuleAnalyzer(Analyzer):
 
     def analyze(self, path: str, content: bytes) -> AnalysisResult:
         r = AnalysisResult()
-        data = self.mod.analyze(path, content)
+        # modules see rooted paths (module.go:390 prefixes "/")
+        file_path = path if path.startswith("/") else "/" + path
+        data = self.mod.analyze(file_path, content)
         if data:
+            rtype, payload = self.type, data
+            if isinstance(data, dict) and \
+                    set(data) == {"type", "data"}:
+                # EXACTLY {type, data}: the module declares its own
+                # resource type + bare payload
+                # (serialize.CustomResource{Type, Data} shape);
+                # any other dict is an opaque legacy payload
+                rtype, payload = str(data["type"]), data["data"]
             r.custom_resources.append(CustomResource(
-                type=self.type, file_path=path, data=data))
+                type=rtype, file_path=file_path, data=payload))
         return r
 
 

@@ -124,7 +124,8 @@ class LocalScanner:
             if detail.os is not None:
                 detail.os.eosl = prepared.eosl
             vuln_results = self._vuln_results(
-                prepared.target.name, detail, detected)
+                prepared.target.name, detail, detected,
+                options.vuln_type)
             results.extend(self._fill_pkgs(prepared.pkg_results,
                                            vuln_results))
         else:
@@ -140,12 +141,21 @@ class LocalScanner:
             results.extend(self._license_results(
                 detail, getattr(options, "license_categories", None)))
 
+        # module-collected custom resources ride a Result of class
+        # "custom" so post-scanners can read them (ref
+        # local/scan.go:154-163)
+        if detail.custom_resources:
+            results.append(Result(
+                target="", class_=ResultClass.CUSTOM,
+                custom_resources=list(detail.custom_resources)))
+
         for r in results:
             fill_info(self.store, r.vulnerabilities)
 
         # post-scan hook chain (ref local/scan.go:170-174 post.Scan)
         from .post import post_scan
         results = post_scan(results)
+
         return results, detail.os
 
     # --- vulnerabilities ---
@@ -214,7 +224,7 @@ class LocalScanner:
         return jobs, eosl
 
     def _vuln_results(self, target: str, detail,
-                      detected: list) -> list:
+                      detected: list, vuln_type: list) -> list:
         os_vulns: list = []
         app_vulns: dict = {}
         for payload in detected:
@@ -225,7 +235,16 @@ class LocalScanner:
                 app_vulns.setdefault(key, []).append(vuln)
 
         results = []
-        if os_vulns or (detail.os is not None and detail.packages):
+        # the os-pkgs result is emitted whenever a known distro was
+        # detected, even with zero findings (ref scan.go:243-271
+        # scanOSPkgs returns a Result unless the OS is unknown or
+        # unsupported; empty results are never filtered out)
+        # gated on the os vuln type, like scanVulnerabilities
+        # dispatch — `--vuln-type library` must not emit the husk
+        has_driver = ("os" in vuln_type
+                      and detail.os is not None
+                      and DRIVERS.get(detail.os.family) is not None)
+        if os_vulns or has_driver:
             target_name = target
             if detail.os is not None and detail.os.family and \
                     detail.os.family != "none":
