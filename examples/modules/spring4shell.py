@@ -69,7 +69,12 @@ def post_scan(results):
             continue
         for c in r.custom_resources:
             if c.type == TYPE_JAVA_MAJOR:
-                java_major = _java_major(str(c.data))
+                # invalid versions are skipped, never overwrite a
+                # previously parsed one (spring4shell.go:237-252
+                # warns and continues)
+                parsed = _java_major(str(c.data))
+                if parsed:
+                    java_major = parsed
             elif c.type == TYPE_TOMCAT:
                 tomcat = str(c.data)
 

@@ -999,3 +999,171 @@ def test_image_golden_spring4shell(label, java_release, golden_name,
                 v.pop("PublishedDate", None)
                 v.pop("LastModifiedDate", None)
     assert ours == want
+
+
+def test_image_golden_alpine310_registry(tmp_path, monkeypatch):
+    """alpine-310 scanned BY REGISTRY REFERENCE through an
+    in-process /v2 registry (ref integration/registry_test.go uses
+    a testcontainers registry): token-less pull, digest-pinned
+    RepoDigests, same findings as the tarball scan."""
+    import gzip as _gzip
+    import hashlib as _hashlib
+    from tests.test_registry import FakeRegistry, _layer_tar
+    from trivy_tpu import cli
+
+    golden = json.load(open(os.path.join(
+        REF, "testdata", "alpine-310-registry.json.golden")))
+    reg = FakeRegistry()
+    layer = _layer_tar({
+        "etc/alpine-release": b"3.10.2\n",
+        "lib/apk/db/installed": "".join(
+            _apk_para(n, v, o)
+            for n, v, o in ALPINE_310_PKGS).encode()})
+    diff_id = "sha256:" + _hashlib.sha256(
+        _gzip.decompress(layer)).hexdigest()
+    ldesc = reg.put_blob(layer)
+    ldesc["mediaType"] = \
+        "application/vnd.docker.image.rootfs.diff.tar.gzip"
+    config = dict(golden["Metadata"]["ImageConfig"])
+    config["rootfs"] = {"type": "layers", "diff_ids": [diff_id]}
+    config_bytes = json.dumps(config).encode()
+    cdesc = reg.put_blob(config_bytes)
+    cdesc["mediaType"] = \
+        "application/vnd.docker.container.image.v1+json"
+    from trivy_tpu.artifact.registry import MT_MANIFEST
+    manifest = json.dumps({
+        "schemaVersion": 2, "mediaType": MT_MANIFEST,
+        "config": cdesc, "layers": [ldesc]}).encode()
+    mdigest = "sha256:" + _hashlib.sha256(manifest).hexdigest()
+    reg.manifests["3.10"] = (MT_MANIFEST, manifest)
+    reg.manifests[mdigest] = (MT_MANIFEST, manifest)
+    reg.start()
+    port = reg.port
+    try:
+        out = tmp_path / "report.json"
+        rc = cli.main([
+            "image", f"localhost:{port}/alpine:3.10",
+            "--format", "json", "--output", str(out),
+            "--backend", "cpu", "--no-cache",
+            "--security-checks", "vuln",
+            "--cache-dir", str(tmp_path / "c"),
+            "--db-fixtures", _db_paths()])
+    finally:
+        reg.stop()
+    assert rc == 0
+    ours = _norm_image(json.loads(out.read_text()))
+    want = _norm_image(golden)
+
+    def norm_reg(o, host):
+        o["ArtifactName"] = o["ArtifactName"].replace(
+            host, "REGISTRY")
+        meta = o["Metadata"]
+        meta["RepoTags"] = [t.replace(host, "REGISTRY")
+                            for t in meta.get("RepoTags", [])]
+        meta["RepoDigests"] = ["REGISTRY/alpine@sha256:normalized"
+                               for _ in meta.get("RepoDigests", [])]
+        for r in o.get("Results") or []:
+            r["Target"] = r["Target"].replace(host, "REGISTRY")
+        return o
+
+    ours = norm_reg(ours, f"localhost:{port}")
+    want = norm_reg(want, "localhost:63577")
+    ours["Metadata"]["OS"].pop("EOSL", None)
+    want["Metadata"]["OS"].pop("EOSL", None)
+    assert ours == want
+
+
+SBOM_CDX_CASES = [
+    ("centos7", "centos-7-cyclonedx.json",
+     "centos-7-cyclonedx.json.golden"),
+    ("fluentd", "fluentd-multiple-lockfiles-cyclonedx.json",
+     "fluentd-multiple-lockfiles-cyclonedx.json.golden"),
+    ("centos7-intoto", "centos-7-cyclonedx.intoto.jsonl",
+     "centos-7-cyclonedx.json.golden"),
+]
+
+
+@pytest.mark.parametrize("label,fixture,golden_name",
+                         SBOM_CDX_CASES,
+                         ids=[c[0] for c in SBOM_CDX_CASES])
+def test_sbom_golden_cyclonedx(label, fixture, golden_name,
+                               tmp_path, monkeypatch):
+    """`trivy sbom <bom> --format cyclonedx` golden parity (ref
+    integration/sbom_test.go): a CycloneDX (or in-toto-wrapped)
+    input rescans into a vulnerabilities-only BOM whose affects
+    refs point back into the original BOM. Timestamp and tool
+    version are run-dependent and normalized."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "out.cdx.json"
+    rc = cli.main([
+        "sbom", f"testdata/fixtures/sbom/{fixture}",
+        "--format", "cyclonedx", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--cache-dir", str(tmp_path / "c"),
+        "--db-fixtures", _db_paths()])
+    assert rc == 0
+    ours = json.loads(out.read_text())
+    want = json.load(open(os.path.join(
+        REF, "testdata", golden_name)))
+    for o in (ours, want):
+        o["metadata"]["timestamp"] = "normalized"
+        for tool in o["metadata"].get("tools", []):
+            tool["version"] = "normalized"
+    assert ours == want
+
+
+SBOM_SPDX_CASES = [
+    ("tag-value", "centos-7-spdx.txt"),
+    ("json", "centos-7-spdx.json"),
+]
+
+
+@pytest.mark.parametrize("label,fixture", SBOM_SPDX_CASES,
+                         ids=[c[0] for c in SBOM_SPDX_CASES])
+def test_sbom_golden_spdx_rescan(label, fixture, tmp_path,
+                                 monkeypatch):
+    """`trivy sbom <spdx>` rescans to the centos-7 JSON golden with
+    the reference's own overrides (sbom_test.go:144-167
+    compareSBOMReports): artifact identity replaced, image
+    metadata cleared, per-vuln Refs carry the BOM's purls, layer
+    DiffIDs cleared."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "out.json"
+    rc = cli.main([
+        "sbom", f"testdata/fixtures/sbom/{fixture}",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--cache-dir", str(tmp_path / "c"),
+        "--db-fixtures", _db_paths()])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))
+    want = norm(json.load(open(os.path.join(
+        REF, "testdata", "centos-7.json.golden"))))
+
+    path = f"testdata/fixtures/sbom/{fixture}"
+    want["ArtifactName"] = path
+    want["ArtifactType"] = "spdx"
+    # the reference's compare zeroes these on the want side and
+    # its own output carries Go zero-structs; normalize both sides
+    for o in (ours, want):
+        for key in ("ImageID", "ImageConfig", "DiffIDs"):
+            o["Metadata"].pop(key, None)
+    refs = ["pkg:rpm/centos/bash@4.2.46-31.el7?arch=x86_64"
+            "&distro=centos-7.6.1810",
+            "pkg:rpm/centos/openssl-libs@1:1.0.2k-16.el7"
+            "?arch=x86_64&distro=centos-7.6.1810",
+            "pkg:rpm/centos/openssl-libs@1:1.0.2k-16.el7"
+            "?arch=x86_64&distro=centos-7.6.1810"]
+    want["Results"][0]["Target"] = f"{path} (centos 7.6.1810)"
+    for v, ref in zip(want["Results"][0]["Vulnerabilities"], refs):
+        v["Ref"] = ref
+        v.get("Layer", {}).pop("DiffID", None)
+    for r in ours.get("Results") or []:
+        for v in r.get("Vulnerabilities") or []:
+            v.get("Layer", {}).pop("DiffID", None)
+    # wall-clock EOSL (centos 7 went EOL after the golden)
+    ours["Metadata"]["OS"].pop("EOSL", None)
+    want["Metadata"]["OS"].pop("EOSL", None)
+    assert ours == want

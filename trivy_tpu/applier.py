@@ -142,19 +142,28 @@ def apply_layers(layers: list) -> ArtifactDetail:
 
     for pkg in merged.packages:
         if single is not None:
-            digest, diff_id = single.digest, single.diff_id
+            # SBOM-decoded packages carry the ORIGINAL image layer
+            # they came from (spdx attributionTexts / cyclonedx
+            # properties); the rescan keeps it rather than
+            # attributing to the sbom blob (centos-7 sbom goldens)
+            if pkg.layer is None or pkg.layer.empty():
+                pkg.layer = Layer(digest=single.digest,
+                                  diff_id=single.diff_id)
             pkg.build_info = single.build_info
         else:
             digest, diff_id, idx = origin_idx.get(
                 (pkg.name, pkg.version, pkg.release), ("", "", -1))
             pkg.build_info = _lookup_build_info(idx, real)
-        pkg.layer = Layer(digest=digest, diff_id=diff_id)
+            pkg.layer = Layer(digest=digest, diff_id=diff_id)
         if pkg.name in dpkg_licenses:
             pkg.licenses = dpkg_licenses[pkg.name]
 
     for app in merged.applications:
         for lib in app.libraries:
             if single is not None:
+                if lib.layer is not None and \
+                        not lib.layer.empty():
+                    continue      # SBOM-decoded origin layer kept
                 digest, diff_id = single.digest, single.diff_id
             else:
                 digest, diff_id = _origin_layer_lib(

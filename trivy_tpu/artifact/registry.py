@@ -247,6 +247,11 @@ class DistributionClient:
         hdrs, body = self._get(
             registry, f"/v2/{repo}/manifests/{reference}")
         self._verify_manifest(body, reference)
+        # the digest of the manifest the registry served for the
+        # ORIGINAL reference — for a multi-arch tag that is the
+        # index digest, the same digest docker records
+        # (remote.go:95-98 descriptor.Digest)
+        served_digest = "sha256:" + hashlib.sha256(body).hexdigest()
         ctype = (hdrs.get("Content-Type") or "").split(";")[0]
         manifest = json.loads(body)
         if ctype in (MT_MANIFEST_LIST, MT_OCI_INDEX) or \
@@ -287,6 +292,15 @@ class DistributionClient:
             }]}, f)
 
         src = load_image(layout, name=ref)
+        # repo metadata like the reference's remote image
+        # (remote.go:87-98): tags only for tag references — a
+        # digest-pinned pull reports no RepoTags — and RepoDigests
+        # pin the digest served for the original reference
+        if "@" in ref:
+            src.repo_tags = []
+        else:
+            src.repo_tags = [f"{registry}/{repo}:{reference}"]
+        src.repo_digests = [f"{registry}/{repo}@{served_digest}"]
         src.cleanup = lambda: shutil.rmtree(layout,
                                             ignore_errors=True)
         atexit.register(src.cleanup)

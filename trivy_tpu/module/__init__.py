@@ -14,8 +14,13 @@ attributes:
     is_post_scanner = True      # implement post_scan(results)
     required_files = [r"\\.java$"]   # regex list, like Required()
 
-Analyzer modules see (path, content) and return a dict of custom
-resource data (surfaced as CustomResources); post-scanner modules
+Analyzer modules see (path, content) and return either a dict with
+EXACTLY the keys ``{"type", "data"}`` — a self-typed custom resource
+(serialize.CustomResource shape: the declared type plus a bare
+payload) — or any other dict, stored opaquely under the module's own
+``module:<name>`` type. Payload dicts that legitimately need keys
+named type+data must add any third key to stay opaque.
+Post-scanner modules
 rewrite the results list (INSERT/UPDATE/DELETE by returning the
 modified list, api/api.go's action set collapsed into
 return-the-new-results).

@@ -300,8 +300,9 @@ class Marshaler:
                        reverse=True)
         mc = (src.get("metadata") or {}).get("component") or {}
         comp = {"name": mc.get("name", ""),
-                "version": mc.get("version", ""),
                 "type": mc.get("type", "")}
+        if mc.get("version"):
+            comp["version"] = mc["version"]
         if serial:
             comp["bom-ref"] = f"{serial}/{version}"
         return {
@@ -451,9 +452,18 @@ def _pkg_component(pkg_type: str, pkg: Package, os_found) -> dict:
     return comp
 
 
+def _offset_ts(ts: str) -> str:
+    """RFC3339 with an explicit +00:00 offset — Go's cdx encoder
+    renders UTC times that way, not with Z."""
+    return ts[:-1] + "+00:00" if ts.endswith("Z") else ts
+
+
 def _affects(ref: str, version: str) -> dict:
+    # CycloneDX 1.4 key is "versions" (cdx-go affects.Range maps to
+    # it; centos-7-cyclonedx.json.golden)
     return {"ref": ref,
-            "range": [{"version": version, "status": "affected"}]}
+            "versions": [{"version": version,
+                          "status": "affected"}]}
 
 
 def _vulnerability(ref: str, v) -> dict:
@@ -482,9 +492,9 @@ def _vulnerability(ref: str, v) -> dict:
             vuln["advisories"] = [{"url": r}
                                   for r in detail.references]
         if detail.published_date:
-            vuln["published"] = detail.published_date
+            vuln["published"] = _offset_ts(detail.published_date)
         if detail.last_modified_date:
-            vuln["updated"] = detail.last_modified_date
+            vuln["updated"] = _offset_ts(detail.last_modified_date)
     return vuln
 
 
