@@ -166,3 +166,32 @@ class TestCLIClientServer:
         ids = [v["VulnerabilityID"] for r in report["Results"]
                for v in r.get("Vulnerabilities", [])]
         assert ids == ["CVE-2019-14697"]
+
+
+def test_deprecated_client_command(server, tmp_path):
+    """`trivy-tpu client --remote URL` is the deprecated alias of
+    `image --server URL` (ref app.go:441 NewClientCommand)."""
+    import contextlib
+    import io
+    import json as _json
+
+    from tests.test_e2e_image import make_image_tar
+    from trivy_tpu.cli import main
+
+    _, url = server
+    img = make_image_tar(tmp_path, [{
+        "etc/alpine-release": b"3.9.4\n",
+        "lib/apk/db/installed":
+            b"P:musl\nV:1.1.20-r4\no:musl\nL:MIT\n\n"}])
+    out = tmp_path / "r.json"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        code = main(["client", "--input", img,
+                     "--remote", url, "--token", "s3cret",
+                     "--format", "json", "--output", str(out),
+                     "--cache-dir", str(tmp_path / "c")])
+    assert code == 0
+    ids = [v["VulnerabilityID"]
+           for r in _json.loads(out.read_text())["Results"]
+           for v in r.get("Vulnerabilities", [])]
+    assert "CVE-2019-14697" in ids

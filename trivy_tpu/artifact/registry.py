@@ -78,6 +78,16 @@ def parse_ref(ref: str) -> tuple:
     return registry, repo, digest or tag or "latest"
 
 
+def _display_repo(registry: str, repo: str) -> str:
+    """Familiar repository name (remote.go RepositoryName /
+    go-containerregistry name): the default registry is omitted and
+    its library/ prefix trimmed — `alpine:3.10`, not
+    `index.docker.io/library/alpine:3.10`."""
+    if registry == "index.docker.io":
+        return repo.removeprefix("library/")
+    return f"{registry}/{repo}"
+
+
 def _is_loopback(registry: str) -> bool:
     host = registry.split(":")[0]
     return host in ("localhost", "::1") or host.startswith("127.")
@@ -296,11 +306,12 @@ class DistributionClient:
         # (remote.go:87-98): tags only for tag references — a
         # digest-pinned pull reports no RepoTags — and RepoDigests
         # pin the digest served for the original reference
+        display = _display_repo(registry, repo)
         if "@" in ref:
             src.repo_tags = []
         else:
-            src.repo_tags = [f"{registry}/{repo}:{reference}"]
-        src.repo_digests = [f"{registry}/{repo}@{served_digest}"]
+            src.repo_tags = [f"{display}:{reference}"]
+        src.repo_digests = [f"{display}@{served_digest}"]
         src.cleanup = lambda: shutil.rmtree(layout,
                                             ignore_errors=True)
         atexit.register(src.cleanup)

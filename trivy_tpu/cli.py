@@ -174,6 +174,18 @@ def build_parser() -> argparse.ArgumentParser:
     sbom.add_argument("target")
     scan_flags(sbom)
 
+    cl = sub.add_parser("client", aliases=["c"],
+                        help="DEPRECATED: image scan in "
+                        "client/server mode (ref app.go:441 "
+                        "NewClientCommand; use `image --server` "
+                        "instead)")
+    cl.add_argument("--remote", default="http://localhost:4954",
+                    help="server address (the deprecated spelling "
+                    "of --server)")
+    cl.add_argument("--input", default="")
+    cl.add_argument("target", nargs="?", default="")
+    scan_flags(cl)
+
     conf = sub.add_parser("config", aliases=["conf"],
                           help="scan config files for "
                           "misconfigurations only (ref "
@@ -284,7 +296,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
                    "sbom", "k8s", "aws", "db", "server", "plugin",
-                   "config", "conf", "module", "m", "version")
+                   "config", "conf", "module", "m", "client", "c",
+                   "version")
 
 
 def main(argv=None) -> int:
@@ -358,7 +371,8 @@ def _dispatch(args) -> int:
     if getattr(args, "generate_default_config", False):
         return _generate_default_config(args)
     if args.command in ("image", "filesystem", "fs", "rootfs",
-                        "repo", "sbom", "k8s", "config", "conf"):
+                        "repo", "sbom", "k8s", "config", "conf",
+                        "client", "c"):
         from .module import Manager as _ModuleManager
         _ModuleManager().load()
     if args.command in ("image",):
@@ -371,6 +385,14 @@ def _dispatch(args) -> int:
         args.security_checks = "config"
         args.vuln_type = ""
         return run_fs(args)
+    if args.command in ("client", "c"):
+        # deprecated alias for `image --server` (app.go:441-447:
+        # --remote replaces --server)
+        print("WARN: 'client' is deprecated; use "
+              "'image --server' instead", file=sys.stderr)
+        # an explicit --server wins over the deprecated --remote
+        args.server = args.server or args.remote
+        return run_image(args)
     if args.command in ("module", "m"):
         return run_module(args)
     if args.command == "repo":
