@@ -27,8 +27,7 @@ class ApkAnalyzer(Analyzer):
     type = "apk"
     version = 2
 
-    def required(self, path, size=None):
-        return path == _REQUIRED
+    exact_paths = frozenset({_REQUIRED})
 
     def analyze(self, path, content):
         pkgs, installed_files = self._parse(content)

@@ -41,8 +41,7 @@ class NpmLockAnalyzer(Analyzer):
     type = "npm"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "package-lock.json"
+    basenames = frozenset({"package-lock.json"})
 
     def analyze(self, path, content):
         from ..utils.jsonloc import parse_with_lines
@@ -122,8 +121,7 @@ class YarnLockAnalyzer(Analyzer):
     type = "yarn"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "yarn.lock"
+    basenames = frozenset({"yarn.lock"})
 
     def analyze(self, path, content):
         pkgs: dict = {}
@@ -151,8 +149,7 @@ class PipfileLockAnalyzer(Analyzer):
     type = "pipenv"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "Pipfile.lock"
+    basenames = frozenset({"Pipfile.lock"})
 
     def analyze(self, path, content):
         try:
@@ -173,8 +170,7 @@ class PoetryLockAnalyzer(Analyzer):
     type = "poetry"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "poetry.lock"
+    basenames = frozenset({"poetry.lock"})
 
     def analyze(self, path, content):
         import tomllib
@@ -198,8 +194,7 @@ class RequirementsAnalyzer(Analyzer):
     _LINE = re.compile(
         r"^(?P<name>[A-Za-z0-9._-]+)\s*==\s*(?P<ver>[^\s;#]+)")
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "requirements.txt"
+    basenames = frozenset({"requirements.txt"})
 
     def analyze(self, path, content):
         # reference pip parser emits bare name/version (no ID)
@@ -220,8 +215,7 @@ class GemfileLockAnalyzer(Analyzer):
     type = "bundler"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "Gemfile.lock"
+    basenames = frozenset({"Gemfile.lock"})
 
     def analyze(self, path, content):
         pkgs = []
@@ -245,8 +239,7 @@ class ComposerLockAnalyzer(Analyzer):
     type = "composer"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "composer.lock"
+    basenames = frozenset({"composer.lock"})
 
     def analyze(self, path, content):
         try:
@@ -268,8 +261,7 @@ class CargoLockAnalyzer(Analyzer):
     type = "cargo"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "Cargo.lock"
+    basenames = frozenset({"Cargo.lock"})
 
     def analyze(self, path, content):
         import tomllib
@@ -295,8 +287,7 @@ class PnpmLockAnalyzer(Analyzer):
     type = "pnpm"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "pnpm-lock.yaml"
+    basenames = frozenset({"pnpm-lock.yaml"})
 
     def analyze(self, path, content):
         try:
@@ -347,8 +338,7 @@ class ConanLockAnalyzer(Analyzer):
     type = "conan"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "conan.lock"
+    basenames = frozenset({"conan.lock"})
 
     def analyze(self, path, content):
         try:
@@ -387,8 +377,7 @@ class PomAnalyzer(Analyzer):
     type = "pom"
     version = 1
 
-    def required(self, path, size=None):
-        return posixpath.basename(path) == "pom.xml"
+    basenames = frozenset({"pom.xml"})
 
     def analyze(self, path, content):
         import xml.etree.ElementTree as ET

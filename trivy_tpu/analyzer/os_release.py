@@ -22,8 +22,7 @@ class AlpineReleaseAnalyzer(Analyzer):
     type = "alpine"
     version = 1
 
-    def required(self, path, size=None):
-        return path == "etc/alpine-release"
+    exact_paths = frozenset({"etc/alpine-release"})
 
     def analyze(self, path, content):
         ver = _decode(content).strip()
@@ -43,8 +42,7 @@ class AlpineRepoAnalyzer(Analyzer):
     _URL = re.compile(
         r"/(v?(?P<ver>[0-9]+\.[0-9]+|edge))/(?P<repo>main|community)")
 
-    def required(self, path, size=None):
-        return path == "etc/apk/repositories"
+    exact_paths = frozenset({"etc/apk/repositories"})
 
     def analyze(self, path, content):
         release = None
@@ -78,8 +76,7 @@ class DebianVersionAnalyzer(Analyzer):
     type = "debian"
     version = 1
 
-    def required(self, path, size=None):
-        return path == "etc/debian_version"
+    exact_paths = frozenset({"etc/debian_version"})
 
     def analyze(self, path, content):
         ver = _decode(content).strip()
@@ -95,8 +92,7 @@ class LsbReleaseAnalyzer(Analyzer):
     type = "ubuntu"
     version = 1
 
-    def required(self, path, size=None):
-        return path == "etc/lsb-release"
+    exact_paths = frozenset({"etc/lsb-release"})
 
     def analyze(self, path, content):
         distrib, release = "", ""
@@ -144,8 +140,7 @@ class RedHatBaseAnalyzer(Analyzer):
     type = "redhatbase"
     version = 1
 
-    def required(self, path, size=None):
-        return path in _REDHAT_FILES
+    exact_paths = frozenset(_REDHAT_FILES)
 
     def analyze(self, path, content):
         text = _decode(content).strip()
@@ -194,8 +189,8 @@ class OsReleaseAnalyzer(Analyzer):
     type = "os-release"
     version = 1
 
-    def required(self, path, size=None):
-        return path in ("etc/os-release", "usr/lib/os-release")
+    exact_paths = frozenset({"etc/os-release",
+                             "usr/lib/os-release"})
 
     def analyze(self, path, content):
         fields = {}

@@ -61,8 +61,7 @@ class NodePkgAnalyzer(Analyzer):
     type = "node-pkg"
     version = 1
 
-    def required(self, path: str, size: Optional[int] = None) -> bool:
-        return posixpath.basename(path) == "package.json"
+    basenames = frozenset({"package.json"})
 
     def analyze(self, path: str, content: bytes) -> AnalysisResult:
         try:

@@ -56,8 +56,7 @@ class RpmDBAnalyzer(Analyzer):
     type = "rpm"
     version = 1
 
-    def required(self, path, size=None):
-        return path in REQUIRED_PATHS
+    exact_paths = frozenset(REQUIRED_PATHS)
 
     def analyze(self, path, content):
         from ..rpmdb import list_packages
@@ -92,8 +91,7 @@ class RpmQaAnalyzer(Analyzer):
 
     _PATHS = {"var/lib/rpmmanifest/container-manifest-2"}
 
-    def required(self, path, size=None):
-        return path in self._PATHS
+    exact_paths = frozenset(_PATHS)
 
     def analyze(self, path, content):
         from ..rpmdb.header import RpmPackage

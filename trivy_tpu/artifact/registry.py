@@ -83,7 +83,8 @@ def _display_repo(registry: str, repo: str) -> str:
     go-containerregistry name): the default registry is omitted and
     its library/ prefix trimmed — `alpine:3.10`, not
     `index.docker.io/library/alpine:3.10`."""
-    if registry == "index.docker.io":
+    if registry in ("index.docker.io", "docker.io",
+                    "registry-1.docker.io"):
         return repo.removeprefix("library/")
     return f"{registry}/{repo}"
 
