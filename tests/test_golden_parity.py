@@ -1167,3 +1167,37 @@ def test_sbom_golden_spdx_rescan(label, fixture, tmp_path,
     ours["Metadata"]["OS"].pop("EOSL", None)
     want["Metadata"]["OS"].pop("EOSL", None)
     assert ours == want
+
+
+DOCKERFILE_GOLDEN_CASES = [
+    ("builtin", "dockerfile", [], "dockerfile.json.golden"),
+    ("file-patterns", "dockerfile_file_pattern",
+     ["--file-patterns", "dockerfile:Customfile"],
+     "dockerfile_file_pattern.json.golden"),
+]
+
+
+@pytest.mark.parametrize("label,fixture,extra,golden_name",
+                         DOCKERFILE_GOLDEN_CASES,
+                         ids=[c[0] for c in DOCKERFILE_GOLDEN_CASES])
+def test_config_golden_dockerfile(label, fixture, extra,
+                                  golden_name, tmp_path,
+                                  monkeypatch):
+    """Dockerfile misconfiguration goldens: the full embedded check
+    set must evaluate exactly the reference's 22 policies (21 pass +
+    DS002 on a bare FROM), incl. the --file-patterns override that
+    routes an arbitrary filename into the dockerfile analyzer."""
+    from trivy_tpu import cli
+    monkeypatch.chdir(REF)
+    out = tmp_path / "report.json"
+    rc = cli.main([
+        "fs", f"testdata/fixtures/fs/{fixture}",
+        "--security-checks", "config",
+        "--format", "json", "--output", str(out),
+        "--backend", "cpu", "--no-cache",
+        "--cache-dir", str(tmp_path / "c"), *extra])
+    assert rc == 0
+    ours = norm(json.loads(out.read_text()))
+    want = norm(json.load(open(
+        os.path.join(REF, "testdata", golden_name))))
+    assert ours == want

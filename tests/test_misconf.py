@@ -74,7 +74,7 @@ class TestDockerfilePolicies:
         mc = self._scan(BAD_DOCKERFILE)
         assert mc.file_type == "dockerfile"
         ids = {r.id for r in mc.failures}
-        assert ids == {"DS001", "DS002", "DS004", "DS005", "DS026"}
+        assert ids == {"DS001", "DS002", "DS004", "DS005"}
         root = [r for r in mc.failures if r.id == "DS002"][0]
         assert root.cause_metadata.start_line == 5
         assert "root" in root.message
@@ -82,7 +82,12 @@ class TestDockerfilePolicies:
     def test_good_dockerfile_passes(self):
         mc = self._scan(GOOD_DOCKERFILE)
         assert mc.failures == []
-        assert {r.id for r in mc.successes} == {"DS001", "DS002", "DS004", "DS005", "DS006", "DS007", "DS008", "DS009", "DS010", "DS013", "DS016", "DS017", "DS022", "DS023", "DS025", "DS026"}
+        # the reference vintage's full embedded set: 22 checks
+        assert {r.id for r in mc.successes} == {
+            "DS001", "DS002", "DS004", "DS005", "DS006", "DS007",
+            "DS008", "DS009", "DS010", "DS011", "DS012", "DS013",
+            "DS014", "DS015", "DS016", "DS017", "DS019", "DS021",
+            "DS022", "DS023", "DS024", "DS025"}
 
     def test_missing_user(self):
         mc = self._scan(b"FROM alpine:3.16\nRUN true\n")
@@ -199,7 +204,7 @@ class TestEndToEnd:
         dockerfile = by_target["Dockerfile"]
         assert dockerfile["Class"] == "config"
         assert dockerfile["Type"] == "dockerfile"
-        assert dockerfile["MisconfSummary"]["Failures"] == 5
+        assert dockerfile["MisconfSummary"]["Failures"] == 4
         ids = {m["ID"] for m in dockerfile["Misconfigurations"]}
         assert "DS002" in ids
         root_user = [m for m in dockerfile["Misconfigurations"]
@@ -226,7 +231,7 @@ class TestEndToEnd:
         assert code == 0
         report = json.loads(out_file.read_text())
         r = report["Results"][0]
-        assert r["MisconfSummary"]["Successes"] == 16
+        assert r["MisconfSummary"]["Successes"] == 22
         assert all(m["Status"] == "PASS"
                    for m in r["Misconfigurations"])
 
@@ -252,7 +257,7 @@ class TestEndToEnd:
             "--cache-dir", str(tmp_path / "cache")])   # disk cache
         assert code == 0
         report = json.loads(out_file.read_text())
-        assert report["Results"][0]["MisconfSummary"]["Failures"] == 5
+        assert report["Results"][0]["MisconfSummary"]["Failures"] == 4
         # second run hits the cached blob — findings identical
         code, _ = self._run([
             "fs", str(tmp_path / "app"),
@@ -277,7 +282,7 @@ class TestEndToEnd:
         assert code == 0
         report = json.loads(out_file.read_text())
         assert report["Results"][0]["MisconfSummary"][
-            "Successes"] == 16
+            "Successes"] == 22
         assert "Misconfigurations" not in report["Results"][0]
 
     def test_container_level_run_as_nonroot_false(self):
@@ -498,13 +503,46 @@ class TestExtendedDockerfilePolicies:
             b"FROM alpine:3.16\nRUN cd /tmp && make\nUSER app\n"
             b"HEALTHCHECK CMD true\n")
 
-    def test_ds017_apt_y(self):
-        assert "DS017" in self._fails(
+    def test_ds021_apt_y(self):
+        assert "DS021" in self._fails(
             b"FROM debian:11\nRUN apt-get install curl\nUSER app\n"
             b"HEALTHCHECK CMD true\n")
-        assert "DS017" not in self._fails(
+        assert "DS021" not in self._fails(
             b"FROM debian:11\nRUN apt-get install -y curl\n"
             b"USER app\nHEALTHCHECK CMD true\n")
+
+    def test_ds017_update_alone(self):
+        assert "DS017" in self._fails(
+            b"FROM debian:11\nRUN apt-get update\nUSER app\n")
+        assert "DS017" not in self._fails(
+            b"FROM debian:11\n"
+            b"RUN apt-get update && apt-get install -y curl\n"
+            b"USER app\n")
+
+    def test_new_vintage_checks(self):
+        # DS011 multi-source COPY, DS012 duplicate alias, DS014
+        # wget+curl, DS015 yum clean, DS019 zypper clean, DS024
+        # dist-upgrade
+        fails = self._fails(
+            b"FROM alpine:3.16 AS a\nFROM alpine:3.16 AS a\n"
+            b"COPY x y /dest\n"
+            b"RUN wget http://u && curl http://u\n"
+            b"RUN yum install -y curl\n"
+            b"RUN zypper install -y curl\n"
+            b"RUN apt-get dist-upgrade -y\nUSER app\n")
+        for want in ("DS011", "DS012", "DS014", "DS015", "DS019",
+                     "DS024"):
+            assert want in fails, want
+        ok = self._fails(
+            b"FROM alpine:3.16 AS a\nFROM alpine:3.16 AS b\n"
+            b"COPY x y /dest/\n"
+            b"RUN curl http://u\n"
+            b"RUN yum install -y curl && yum clean all\n"
+            b"RUN zypper install -y curl && zypper clean\n"
+            b"USER app\n")
+        for bad in ("DS011", "DS012", "DS014", "DS015", "DS019",
+                    "DS024"):
+            assert bad not in ok, bad
 
     def test_ds022_maintainer(self):
         assert "DS022" in self._fails(

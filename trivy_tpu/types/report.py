@@ -77,7 +77,12 @@ class CauseMetadata:
     code: object = jfield("Code", default=None, keep=True)
 
     def to_dict(self) -> dict:
-        return asdict_omitempty(self)
+        d = asdict_omitempty(self)
+        if d.get("Code") is None:
+            # Go marshals the zero Code struct, not null
+            # (ftypes.Code has no omitempty: {"Lines": null})
+            d["Code"] = {"Lines": None}
+        return d
 
 
 @dataclass
