@@ -290,6 +290,71 @@ class TestRedHatContentSets:
         assert "CVE-2099-0001" not in ids
 
 
+class TestRedHatSameCVEMerge:
+    """Several RHSAs can fix one CVE (redhat-oval emits one advisory
+    per (entry, CVE)); the uniqueness pass must MERGE them — newest
+    FixedVersion per the rpm comparer, union of vendor ids — instead
+    of keeping whichever entry it saw first (ref redhat.go
+    uniqVulns)."""
+
+    def _detect(self, order):
+        from trivy_tpu.db.store import AdvisoryStore
+        from trivy_tpu.scan.filter import filter_results
+        from trivy_tpu.types import Result, Severity
+        s = AdvisoryStore()
+        entries = {
+            "RHSA-2099:0001": "1:1.1.1k-7.el8_6",
+            "RHSA-2099:0002": "1:1.1.1k-9.el8_6",
+        }
+        for key in order:
+            s.put_advisory("Red Hat", "openssl", key, {
+                "Entries": [{
+                    "FixedVersion": entries[key],
+                    "Cves": [{"ID": "CVE-2099-1000",
+                              "Severity": 3}],
+                }]})
+        vulns, _ = ospkg_detect("redhat", "8.6", None,
+                                [Package(name="openssl",
+                                         version="1.1.1k",
+                                         release="6.el8", epoch=1,
+                                         arch="x86_64")], s)
+        assert len(vulns) == 2      # both advisories matched
+        result = Result(target="t", vulnerabilities=vulns)
+        filter_results([result], [Severity.parse(sv) for sv in
+                                  ("UNKNOWN", "LOW", "MEDIUM",
+                                   "HIGH", "CRITICAL")])
+        return result.vulnerabilities
+
+    def test_merges_newest_fix_and_unions_vendor_ids(self):
+        merged = self._detect(["RHSA-2099:0001", "RHSA-2099:0002"])
+        assert len(merged) == 1
+        assert merged[0].fixed_version == "1:1.1.1k-9.el8_6"
+        assert merged[0].vendor_ids == ["RHSA-2099:0001",
+                                        "RHSA-2099:0002"]
+
+    def test_merge_is_order_independent(self):
+        a = self._detect(["RHSA-2099:0001", "RHSA-2099:0002"])
+        b = self._detect(["RHSA-2099:0002", "RHSA-2099:0001"])
+        assert a[0].fixed_version == b[0].fixed_version
+        assert a[0].vendor_ids == b[0].vendor_ids
+
+    def test_non_redhat_keeps_first_with_fix(self):
+        from trivy_tpu.scan.filter import filter_results
+        from trivy_tpu.types import (DetectedVulnerability, Result,
+                                     Severity)
+        unfixed = DetectedVulnerability(
+            vulnerability_id="CVE-1", pkg_name="p",
+            installed_version="1")
+        fixed = DetectedVulnerability(
+            vulnerability_id="CVE-1", pkg_name="p",
+            installed_version="1", fixed_version="2")
+        result = Result(target="t",
+                        vulnerabilities=[unfixed, fixed])
+        filter_results([result], [Severity.parse("UNKNOWN")])
+        assert [v.fixed_version
+                for v in result.vulnerabilities] == ["2"]
+
+
 class TestBuildInfoPipeline:
     def test_content_manifest_analyzer(self):
         import json

@@ -421,6 +421,7 @@ def fake_s3():
             self._reply(200 if self.path in store else 404)
 
         def do_DELETE(self):
+            reqs.append((self.command, self.path, None))
             store.pop(self.path, None)
             self._reply(204)
 
@@ -465,6 +466,33 @@ class TestS3Cache:
         cache.delete_blobs(["sha256:b1"])
         assert cache.get_blob("sha256:b1") is None
         assert "/tt-cache/blob/pre/sha256:b1.index" not in store
+
+    def test_index_without_body_raises(self, fake_s3):
+        """s3.go:133-160: the .index marker without its object is an
+        inconsistent cache, not a hit — a phantom hit would make
+        apply_layers silently drop the layer."""
+        from trivy_tpu.artifact.s3_cache import S3Error
+        endpoint, store, _ = fake_s3
+        cache = self._cache(endpoint)
+        blob = BlobInfo(os=OS(family="alpine", name="3.16.0"))
+        cache.put_blob("sha256:b1", blob)
+        del store["/tt-cache/blob/pre/sha256:b1"]   # evict body only
+        with pytest.raises(S3Error):
+            cache.missing_blobs("sha256:a", ["sha256:b1"])
+
+    def test_delete_removes_index_first(self, fake_s3):
+        """An interrupted delete must leave body-without-index (a
+        miss), never index-without-body (a phantom hit)."""
+        endpoint, store, reqs = fake_s3
+        cache = self._cache(endpoint)
+        cache.put_blob("sha256:b1",
+                       BlobInfo(os=OS(family="alpine",
+                                      name="3.16.0")))
+        del reqs[:]
+        cache.delete_blobs(["sha256:b1"])
+        deletes = [p for c, p, _ in reqs if c == "DELETE"]
+        assert deletes == ["/tt-cache/blob/pre/sha256:b1.index",
+                           "/tt-cache/blob/pre/sha256:b1"]
 
     def test_sigv4_header_present(self, fake_s3, monkeypatch):
         endpoint, _, reqs = fake_s3

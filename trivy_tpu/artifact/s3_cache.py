@@ -216,18 +216,42 @@ class S3Cache:
         d = self._get(BLOB_BUCKET, blob_id)
         return blob_info_from_dict(d) if d is not None else None
 
+    def _present(self, bucket: str, id_: str) -> bool:
+        """Index-first existence check that also verifies the BODY is
+        readable (s3.go:133-160 re-reads the record): an interrupted
+        delete or lifecycle eviction can leave the .index marker
+        without its object — reporting that as a cache hit would make
+        get_blob return None and apply_layers silently drop the
+        layer, so index-without-body is an error, not a hit."""
+        if not self._has_index(bucket, id_):
+            return False
+        key = self._key(bucket, id_)
+        status, _ = self.client.request("HEAD", key)
+        if status == 404:
+            raise S3Error(
+                f"s3 cache inconsistent: {key}.index exists but "
+                f"the object is missing (run delete_blobs or evict "
+                f"the marker)")
+        if status >= 300:
+            raise S3Error(f"s3 head {key}: HTTP {status}")
+        return True
+
     def missing_blobs(self, artifact_id: str,
                       blob_ids: list) -> tuple:
         """Index-first existence checks (s3.go:133-160)."""
         missing = [b for b in blob_ids
-                   if not self._has_index(BLOB_BUCKET, b)]
-        missing_artifact = not self._has_index(ARTIFACT_BUCKET,
-                                               artifact_id)
+                   if not self._present(BLOB_BUCKET, b)]
+        missing_artifact = not self._present(ARTIFACT_BUCKET,
+                                             artifact_id)
         return missing_artifact, missing
 
     def delete_blobs(self, blob_ids: list) -> None:
+        # the .index marker goes FIRST: if the delete is interrupted
+        # between the two requests, the leftover state is
+        # body-without-index (a cache miss, re-analyzed next scan),
+        # never index-without-body (a phantom hit)
         for b in blob_ids:
-            for suffix in ("", ".index"):
+            for suffix in (".index", ""):
                 key = self._key(BLOB_BUCKET, b) + suffix
                 status, _ = self.client.request("DELETE", key)
                 if status >= 300 and status != 404:

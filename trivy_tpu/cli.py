@@ -75,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--exit-code", type=int, default=0)
         sp.add_argument("--skip-dirs", default="")
         sp.add_argument("--skip-files", default="")
+        sp.add_argument("--file-patterns", action="append",
+                        default=[], metavar="TYPE:REGEX",
+                        help="force files matching REGEX through the "
+                        "TYPE analyzer (ref scan_flags.go:35-43), "
+                        "e.g. dockerfile:Customfile; repeatable")
         sp.add_argument("--list-all-pkgs", action="store_true")
         sp.add_argument("--dependency-tree", action="store_true",
                         help="show a reversed dependency origin "
@@ -127,6 +132,22 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--profile-dir", default="",
                         help="write a jax.profiler device trace + "
                         "host/device phase timings here")
+        sp.add_argument("--sched", default="on",
+                        choices=["on", "off"],
+                        help="continuous-batching scheduler for "
+                        "multi-image scans (docs/serving.md); off = "
+                        "the direct single-batch path")
+        sp.add_argument("--sched-stats", action="store_true",
+                        help="dump scheduler metrics (queue depth, "
+                        "batch occupancy, host/device overlap, "
+                        "latency histograms) to stderr after the "
+                        "scan")
+        sp.add_argument("--sched-flush-ms", type=float, default=50.0,
+                        help="coalescer flush timeout in ms")
+        sp.add_argument("--sched-queue", type=int, default=256,
+                        help="admission queue bound (backpressure)")
+        sp.add_argument("--sched-workers", type=int, default=4,
+                        help="host worker pool size")
         sp.add_argument("--config", "-c", default="",
                         help="config file (default: trivy.yaml)")
         sp.add_argument("--server", default="",
@@ -140,7 +161,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "the server")
 
     img = sub.add_parser("image", help="scan a container image "
-                         "(tarball or OCI layout)")
+                         "(tarball or OCI layout); several targets "
+                         "batch-scan through the scheduler")
     img.add_argument("--input", default="",
                      help="image tarball path (docker save / OCI)")
     img.add_argument("--removed-pkgs", action="store_true",
@@ -148,7 +170,7 @@ def build_parser() -> argparse.ArgumentParser:
                      "removed in the Dockerfile (reconstructed "
                      "from RUN history; alpine only, needs "
                      "TRIVY_APK_INDEX_ARCHIVE_URL)")
-    img.add_argument("target", nargs="?", default="")
+    img.add_argument("target", nargs="*", default=[])
     scan_flags(img)
 
     fs = sub.add_parser("filesystem", aliases=["fs"],
@@ -261,6 +283,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="compiled advisory DB path prefix; the "
                      "server hot-swaps when the file changes")
     srv.add_argument("--db-watch-interval", type=float, default=60.0)
+    srv.add_argument("--sched", default="on",
+                     choices=["on", "off"],
+                     help="coalesce concurrent Scan RPCs through "
+                     "the continuous-batching scheduler; metrics "
+                     "at GET /metrics (docs/serving.md)")
+    srv.add_argument("--sched-flush-ms", type=float, default=50.0)
+    srv.add_argument("--sched-queue", type=int, default=256)
+    srv.add_argument("--sched-workers", type=int, default=4)
+    srv.add_argument("--sched-deadline", default="",
+                     help="default per-request deadline "
+                     "(Go duration, e.g. 30s; requests may "
+                     "override via body deadline_s)")
 
     plug = sub.add_parser("plugin", help="manage plugins")
     plugsub = plug.add_subparsers(dest="plugin_command")
@@ -632,10 +666,24 @@ def run_server(args) -> int:
         else:
             print(f"error: {e}", file=sys.stderr)
             return 1
+    sched = "off"
+    if getattr(args, "sched", "on") == "on":
+        cfg = _sched_config(args)
+        if getattr(args, "sched_deadline", ""):
+            from .flag import parse_duration
+            try:
+                cfg.default_deadline_s = parse_duration(
+                    args.sched_deadline)
+            except ValueError as e:
+                print(f"error: --sched-deadline: {e}",
+                      file=sys.stderr)
+                return 2
+        sched = cfg
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
-                        token_header=args.token_header)
+                        token_header=args.token_header,
+                        sched=sched)
     print(f"trivy-tpu server listening on {args.listen}")
     serve_forever(host or "127.0.0.1", int(port), server,
                   db_watch_prefix=args.compiled_db,
@@ -786,11 +834,43 @@ def _artifact_option(args) -> ArtifactOption:
     return ArtifactOption(
         skip_dirs=[d for d in args.skip_dirs.split(",") if d],
         skip_files=[f for f in args.skip_files.split(",") if f],
+        file_patterns=_file_patterns(
+            getattr(args, "file_patterns", None) or []),
         secret_scanner=scanner,
         scan_secrets="secret" in checks,
         scan_misconfig="config" in checks,
         scan_licenses="license" in checks,
     )
+
+
+def _file_patterns(pairs) -> dict:
+    """--file-patterns TYPE:REGEX pairs → {analyzer type: regex}
+    (ref analyzer.go:451-469 CreateFilePatterns: split on the first
+    colon, reject malformed pairs, compile eagerly so a bad regex
+    fails the run up front). Repeats for one type OR with '|'."""
+    import re as _re
+    if isinstance(pairs, str):          # env/config-file spelling
+        pairs = [p for p in pairs.split(",") if p]
+    out: dict = {}
+    for pair in pairs:
+        atype, sep, pattern = pair.partition(":")
+        if not sep or not atype or not pattern:
+            raise ValueError(
+                f"invalid file pattern {pair!r} "
+                "(want TYPE:REGEX, e.g. dockerfile:Customfile)")
+        try:
+            _re.compile(pattern)
+        except _re.error as e:
+            raise ValueError(
+                f"invalid file pattern regex {pattern!r}: {e}")
+        # non-capturing groups keep each alternative self-contained
+        # (a bare '|' join would let an inline flag in one pattern
+        # leak into — or break compilation of — the others)
+        out[atype] = f"{out[atype]}|(?:{pattern})" \
+            if atype in out else f"(?:{pattern})"
+    for combined in out.values():
+        _re.compile(combined)       # the joined form must compile too
+    return out
 
 
 _SBOM_FORMATS = ("cyclonedx", "spdx", "spdx-json", "github")
@@ -913,7 +993,13 @@ def _scanner(args, cache):
 
 
 def run_image(args) -> int:
-    path = args.input or args.target
+    targets = args.target if isinstance(args.target, list) \
+        else ([args.target] if args.target else [])
+    if len(targets) > 1:
+        return _run_image_batch(args, targets)
+    target = targets[0] if targets else ""
+    args.target = target
+    path = args.input or target
     if not path:
         print("error: image target or --input required",
               file=sys.stderr)
@@ -961,6 +1047,114 @@ def run_image(args) -> int:
         results=results,
     )
     return _finish(args, report)
+
+
+def _sched_config(args):
+    from .sched import SchedConfig
+    return SchedConfig(
+        max_queue=getattr(args, "sched_queue", 256),
+        workers=getattr(args, "sched_workers", 4),
+        flush_timeout_s=getattr(args, "sched_flush_ms", 50.0)
+        / 1000.0)
+
+
+def _run_image_batch(args, targets: list) -> int:
+    """``image a.tar b.tar ...``: the fleet path — every target
+    routes through the continuous-batching scheduler (``--sched off``
+    keeps the direct single-batch ladder for differential runs)."""
+    from .runtime import BatchScanRunner
+    if getattr(args, "server", ""):
+        print("error: multi-image batch scan is local-only; scan "
+              "one target at a time against --server",
+              file=sys.stderr)
+        return 2
+    checks = [c for c in args.security_checks.split(",") if c]
+    store = _store(args) if "vuln" in checks else AdvisoryStore()
+    opt = _artifact_option(args)
+    backend = "cpu-ref" if args.backend == "cpu-ref" \
+        else args.backend
+    runner = BatchScanRunner(
+        store=store, cache=_cache(args), backend=backend,
+        secret_scanner=opt.secret_scanner,
+        sched=("on" if args.sched == "on" else "off"),
+        sched_config=_sched_config(args),
+        artifact_option=opt)
+    try:
+        results = runner.scan_paths(targets, _scan_options(args))
+        stats = runner.last_stats
+    finally:
+        runner.close()
+    if getattr(args, "sched_stats", False):
+        print(json.dumps(stats.get("sched", stats), indent=2),
+              file=sys.stderr)
+    return _finish_many(args, results)
+
+
+def _finish_many(args, results) -> int:
+    """Render one report per batch slot: json emits a single array
+    (fleet reports are machine-read), other formats append to the
+    same stream. Exit code: flag-driven like _finish; slot errors
+    (load failure, deadline) report on stderr and exit 1."""
+    from .scan.filter import IgnorePolicyError, load_ignore_policy
+    try:
+        policy = load_ignore_policy(
+            getattr(args, "ignore_policy", ""))
+    except (OSError, IgnorePolicyError) as e:
+        print(f"error: ignore policy failed: {e}", file=sys.stderr)
+        return 1
+    ignored = load_ignore_file(args.ignorefile)
+    severities = _severities(args.severity)
+    code = 0
+    docs = []
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for res in results:
+            if res.error:
+                print(f"error: {res.name}: {res.error}",
+                      file=sys.stderr)
+                code = max(code, 1)
+                continue
+            report = res.report
+            try:
+                report.results = filter_results(
+                    report.results, severities,
+                    ignore_unfixed=args.ignore_unfixed,
+                    ignored_ids=ignored, policy=policy,
+                    include_non_failures=getattr(
+                        args, "include_non_failures", False))
+            except IgnorePolicyError as e:
+                print(f"error: ignore policy failed: {e}",
+                      file=sys.stderr)
+                return 1
+            if args.format == "json":
+                import io as _io
+                buf = _io.StringIO()
+                write_report(report, fmt="json", output=buf,
+                             severities=[str(s)
+                                         for s in severities],
+                             app_version=__version__)
+                docs.append(json.loads(buf.getvalue()))
+            else:
+                write_report(
+                    report, fmt=args.format, output=out,
+                    severities=[str(s) for s in severities],
+                    app_version=__version__,
+                    output_template=getattr(args, "template", ""),
+                    dependency_tree=getattr(args, "dependency_tree",
+                                            False))
+            if args.exit_code and \
+                    any(r.failed() for r in report.results):
+                code = args.exit_code
+        if args.format == "json":
+            json.dump(docs, out, indent=2)
+            out.write("\n")
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if args.output:
+            out.close()
+    return code
 
 
 def run_sbom(args) -> int:

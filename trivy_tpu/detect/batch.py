@@ -75,17 +75,20 @@ class _RankSpace:
 
 
 # device-kernel wall time of the most recent dispatch_jobs call,
-# for the host/device split in bench + tracing
+# for the host/device split in bench + tracing. Callers that
+# dispatch from several threads (the sched device executor) pass
+# their own ``stats`` sink instead of sharing this module global.
 last_dispatch_stats: dict = {"device_s": 0.0}
 
 
 def detect_pairs(jobs: list, backend: str = "tpu",
-                 mesh=None) -> list:
+                 mesh=None, stats: Optional[dict] = None) -> list:
     """Returns payloads of vulnerable pairs, batch order preserved.
     With ``mesh``, pair rows shard over every chip (see
     parallel.interval_shard)."""
     if not jobs:
         return []
+    sink = stats if stats is not None else last_dispatch_stats
     spaces: dict = {}
     rows = []          # (job, pkg_key, vuln_ivs, sec_ivs, flags)
     host_jobs = []     # fallback: (index, job)
@@ -141,7 +144,7 @@ def detect_pairs(jobs: list, backend: str = "tpu",
         else:
             hits = np.asarray(_device_hits(
                 pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
-        last_dispatch_stats["device_s"] += \
+        sink["device_s"] = sink.get("device_s", 0.0) + \
             _time.perf_counter() - t0
         out.extend(rows[i][0].payload for i in np.nonzero(hits)[0])
 
@@ -248,12 +251,14 @@ class ResidentPairJob:
 
 
 def detect_pairs_resident(jobs: list, backend: str = "tpu",
-                          mesh=None) -> list:
+                          mesh=None,
+                          stats: Optional[dict] = None) -> list:
     """Evaluate ResidentPairJobs in one gather-dispatch against the
     resident tables. Host work is O(jobs): rank lookups are cached
     per (grammar, version); the advisory universe is never touched."""
     if not jobs:
         return []
+    sink = stats if stats is not None else last_dispatch_stats
     from ..db.compiled import F_HOST, F_UNFIXED
 
     cdb = jobs[0].cdb
@@ -301,7 +306,7 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
             tables = cdb.device_tables()
             hits = np.asarray(interval_hits_resident(
                 jnp.asarray(pkg_rank), jnp.asarray(row_idx), *tables))
-        last_dispatch_stats["device_s"] += \
+        sink["device_s"] = sink.get("device_s", 0.0) + \
             _time.perf_counter() - t0
         out.extend(kept[i].payload for i in np.nonzero(hits)[0])
 
@@ -312,18 +317,22 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
 
 
 def dispatch_jobs(jobs: list, backend: str = "tpu",
-                  mesh=None) -> list:
+                  mesh=None, stats: Optional[dict] = None) -> list:
     """Mixed-job dispatcher: classic PairJobs (per-dispatch compile)
-    and ResidentPairJobs (compiled store), each in one kernel call."""
-    last_dispatch_stats["device_s"] = 0.0
+    and ResidentPairJobs (compiled store), each in one kernel call.
+    ``stats`` (optional) receives this call's device_s instead of
+    the shared module global — pass one per thread."""
+    sink = stats if stats is not None else last_dispatch_stats
+    sink["device_s"] = 0.0
     plain = [j for j in jobs if isinstance(j, PairJob)]
     resident = [j for j in jobs if isinstance(j, ResidentPairJob)]
-    out = detect_pairs(plain, backend=backend, mesh=mesh) \
+    out = detect_pairs(plain, backend=backend, mesh=mesh,
+                       stats=sink) \
         if plain else []
     by_db: dict = {}
     for j in resident:
         by_db.setdefault(id(j.cdb), []).append(j)
     for js in by_db.values():
         out.extend(detect_pairs_resident(js, backend=backend,
-                                         mesh=mesh))
+                                         mesh=mesh, stats=sink))
     return out

@@ -24,7 +24,8 @@ import functools
 import numpy as np
 
 from ..ops.intervals import interval_hits_impl
-from .mesh import DATA_AXIS, RULES_AXIS, mesh_axis_sizes, pad_to_multiple
+from .mesh import (DATA_AXIS, RULES_AXIS, mesh_axis_sizes,
+                   pad_to_multiple, shard_map_compat)
 
 _PAIR_AXES = (DATA_AXIS, RULES_AXIS)
 
@@ -37,12 +38,11 @@ def _build_pair_hits(mesh):
     row = P(_PAIR_AXES)
     tbl = P(_PAIR_AXES, None)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         interval_hits_impl,
         mesh=mesh,
         in_specs=(row, tbl, tbl, tbl, tbl, row),
         out_specs=row,
-        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -60,12 +60,11 @@ def _build_resident_hits(mesh):
             pkg_rank, v_lo[row_idx], v_hi[row_idx],
             s_lo[row_idx], s_hi[row_idx], flags[row_idx])
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(row, row, rep, rep, rep, rep, P(None)),
         out_specs=row,
-        check_vma=False,
     )
     return jax.jit(fn)
 

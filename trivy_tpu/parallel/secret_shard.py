@@ -18,7 +18,8 @@ import functools
 import numpy as np
 
 from ..ops.keywords import CODE_CHUNK, code_blockmask_impl
-from .mesh import DATA_AXIS, RULES_AXIS, mesh_axis_sizes, pad_to_multiple
+from .mesh import (DATA_AXIS, RULES_AXIS, mesh_axis_sizes,
+                   pad_to_multiple, shard_map_compat)
 
 
 @functools.lru_cache(maxsize=8)
@@ -30,13 +31,12 @@ def _build_blockmask(mesh, L: int):
         masks = code_blockmask_impl(segments, lo_c, hi_c, lo_m, hi_m)
         return jax.lax.all_gather(masks, RULES_AXIS, axis=1, tiled=True)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(RULES_AXIS), P(RULES_AXIS),
                   P(RULES_AXIS), P(RULES_AXIS)),
         out_specs=P(DATA_AXIS, None),
-        check_vma=False,
     )
     return jax.jit(fn)
 

@@ -35,11 +35,19 @@ DEFAULT_TOKEN_HEADER = "Trivy-Token"
 
 class ScanServer:
     """Request handlers + the swappable store. HTTP-framework-free so
-    tests can drive it directly."""
+    tests can drive it directly.
+
+    With ``sched="on"`` Scan requests route through the continuous-
+    batching scheduler (trivy_tpu.sched): concurrent RPC scans
+    coalesce into shared interval dispatches, a full admission queue
+    answers 503 (the client's transient-retry code), and per-request
+    ``deadline_s`` from the body is honored. ``sched="off"`` keeps
+    the direct one-scan-at-a-time path for differential testing."""
 
     def __init__(self, store=None, cache=None,
                  cache_dir: str = "", token: str = "",
-                 token_header: str = DEFAULT_TOKEN_HEADER):
+                 token_header: str = DEFAULT_TOKEN_HEADER,
+                 sched: str = "off", sched_config=None):
         if isinstance(store, SwappableStore):
             self.store = store
         else:
@@ -50,6 +58,23 @@ class ScanServer:
         self.cache = cache
         self.token = token
         self.token_header = token_header
+        self.scheduler = None
+        self._owns_scheduler = False
+        if hasattr(sched, "submit"):        # a ScanScheduler
+            self.scheduler = sched          # shared — caller closes
+        elif sched not in (None, "off", False):
+            from ..sched import ScanScheduler, SchedConfig
+            cfg = sched_config
+            if isinstance(sched, SchedConfig):
+                cfg = sched
+            self.scheduler = ScanScheduler(config=cfg)
+            self._owns_scheduler = True
+
+    def close(self) -> None:
+        # only tear down a scheduler this server constructed — an
+        # externally provided one may serve other request sources
+        if self.scheduler is not None and self._owns_scheduler:
+            self.scheduler.close()
 
     # ---- Cache service (service.proto:10-15) ----
 
@@ -85,23 +110,66 @@ class ScanServer:
                 "scan_removed_packages", False),
             backend=opts.get("backend", "tpu"),
         )
+        target = ScanTarget(name=body.get("target", ""),
+                            artifact_id=body.get("artifact_id", ""),
+                            blob_ids=body.get("blob_ids") or [])
+        if self.scheduler is not None:
+            return self._scan_scheduled(target, options, body)
         # readers hold the store across the whole scan; swap waits
         # for them to drain (SwappableStore), like the server's
         # dbUpdateWg/requestWg pair
         db = self.store.acquire()
         try:
             scanner = LocalScanner(self.cache, db)
-            results, os_found = scanner.scan(
-                ScanTarget(name=body.get("target", ""),
-                           artifact_id=body.get("artifact_id", ""),
-                           blob_ids=body.get("blob_ids") or []),
-                options)
+            results, os_found = scanner.scan(target, options)
         finally:
             self.store.release()
         return {
             "os": os_found.to_dict() if os_found else None,
             "results": [r.to_dict() for r in results],
         }
+
+    def _scan_scheduled(self, target, options, body: dict) -> dict:
+        """One Scan RPC → one scheduler request; concurrent handler
+        threads coalesce into shared device dispatches. The store
+        reader is held from admission to resolution so a DB hot-swap
+        still waits for in-flight scheduled scans."""
+        from ..sched import AnalyzedWork, ScanRequest
+
+        db = self.store.acquire()
+
+        def analyze(req):
+            scanner = LocalScanner(self.cache, db)
+            prepared = scanner.prepare(target, options)
+
+            def finish(found, detected):
+                results, os_found = scanner.finish(prepared,
+                                                   detected)
+                return {
+                    "os": os_found.to_dict() if os_found else None,
+                    "results": [r.to_dict() for r in results],
+                }
+
+            return AnalyzedWork(jobs=prepared.jobs, finish=finish,
+                                group=options.backend)
+
+        req = ScanRequest(
+            name=target.name, analyze=analyze,
+            deadline_s=float(body.get("deadline_s") or 0.0),
+            group=options.backend,
+            on_done=lambda _req: self.store.release())
+        try:
+            self.scheduler.submit(req)
+        except BaseException:
+            self.store.release()
+            raise
+        return req.result()
+
+    def metrics(self) -> dict:
+        """The /metrics payload: scheduler state when serving is on."""
+        if self.scheduler is None:
+            return {"scheduler": "off"}
+        return self.scheduler.stats()
 
     # ---- dispatch ----
 
@@ -187,6 +255,8 @@ def _make_handler(server: ScanServer):
         def do_GET(self):
             if self.path == "/healthz":
                 self._reply(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                self._reply(200, server.metrics())
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
@@ -207,11 +277,24 @@ def _make_handler(server: ScanServer):
                 self._reply(400, {"code": "malformed",
                                   "msg": "invalid json body"})
                 return
+            from ..sched import DeadlineExceeded, QueueFullError
             try:
                 out = server.handle(self.path, body)
             except LookupError:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
+                return
+            except QueueFullError as e:
+                # backpressure: 503 is the transient code the client
+                # retries with backoff (retry.go's twirp.Unavailable)
+                self._reply(503, {"code": "resource_exhausted",
+                                  "msg": str(e)})
+                return
+            except DeadlineExceeded as e:
+                # the request's own deadline — retrying would expire
+                # again, so answer with a non-retried 4xx
+                self._reply(408, {"code": "deadline_exceeded",
+                                  "msg": str(e)})
                 return
             except Exception as e:          # noqa: BLE001
                 log.warning("rpc %s failed: %r", self.path, e)
@@ -256,4 +339,5 @@ def serve_forever(addr: str, port: int, server: ScanServer,
     finally:
         if worker:
             worker.stop()
+        server.close()
         httpd.shutdown()

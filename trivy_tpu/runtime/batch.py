@@ -47,7 +47,8 @@ class BatchScanResult:
 class BatchScanRunner:
     def __init__(self, store: Optional[AdvisoryStore] = None,
                  cache=None, backend: str = "tpu", mesh=None,
-                 secret_scanner=None):
+                 secret_scanner=None, sched="off",
+                 sched_config=None, artifact_option=None):
         self.store = store or AdvisoryStore()
         self.cache = cache if cache is not None else MemoryCache()
         self.backend = backend
@@ -58,10 +59,51 @@ class BatchScanRunner:
                 backend="cpu-ref" if backend == "cpu-ref" else "tpu",
                 mesh=mesh)
         self.secret_scanner = secret_scanner
+        self.artifact_option = artifact_option
+        # sched: "off" = the direct single-batch ladder below;
+        # "on"/SchedConfig/ScanScheduler = continuous batching with
+        # pipelined host/device overlap (trivy_tpu.sched)
+        self.sched_config = sched_config
+        self._scheduler = None
+        self._owns_scheduler = False
+        if hasattr(sched, "submit"):       # a ScanScheduler
+            self._scheduler = sched        # shared — caller closes
+            self.sched = "on"
+        elif sched not in (None, "off", False):
+            self.sched = "on"
+            from ..sched import SchedConfig
+            if isinstance(sched, SchedConfig):
+                self.sched_config = sched
+        else:
+            self.sched = "off"
         self.last_stats: dict = {}   # phase timings of the last batch
+
+    # --- scheduler plumbing ---
+
+    @property
+    def scheduler(self):
+        if self._scheduler is None:
+            from ..sched import ScanScheduler
+            self._scheduler = ScanScheduler(
+                config=self.sched_config, backend=self.backend,
+                mesh=self.mesh, secret_scanner=self.secret_scanner)
+            self._owns_scheduler = True
+        return self._scheduler
+
+    def close(self) -> None:
+        # only tear down a scheduler this runner constructed — an
+        # externally provided one may serve other request sources
+        if self._scheduler is not None and self._owns_scheduler:
+            self._scheduler.close()
+            self._scheduler = None
 
     def scan_paths(self, paths: list,
                    options: Optional[ScanOptions] = None) -> list:
+        if self.sched == "on":
+            # lazy image load inside analyze() — tar walking is host
+            # work that should overlap device execution too
+            return self._scan_scheduled(
+                [(p, None) for p in paths], options)
         images, failures = [], {}
         for i, p in enumerate(paths):
             try:
@@ -77,9 +119,136 @@ class BatchScanRunner:
 
     def scan_images(self, images: list,
                     options: Optional[ScanOptions] = None) -> list:
+        if self.sched == "on":
+            return self._scan_scheduled(
+                [(getattr(img, "name", ""), img) for img in images],
+                options)
         from ..utils import defer_gc
         with defer_gc():
             return self._scan_images(images, options)
+
+    def _image_opt(self, scan_secrets: bool) -> ArtifactOption:
+        """Per-scan artifact option: the runner-level template (CLI
+        skip dirs / file patterns) with secret scanning routed to the
+        batch sieve instead of a per-artifact scanner."""
+        if self.artifact_option is None:
+            return ArtifactOption(scan_secrets=scan_secrets)
+        import copy
+        opt = copy.copy(self.artifact_option)
+        opt.scan_secrets = scan_secrets and \
+            self.artifact_option.scan_secrets
+        opt.secret_scanner = None
+        return opt
+
+    # --- the scheduled (continuous-batching) route ---
+
+    def _scan_scheduled(self, items: list,
+                        options: Optional[ScanOptions] = None)\
+            -> list:
+        """``items``: [(name, image-or-None)] — None loads the path
+        lazily inside analyze(). Submits one request per image to the
+        scheduler and gathers results in input order; per-request
+        failures (load errors, deadline expiry) fail their own slot,
+        never the fleet."""
+        from ..sched import SchedError
+        options = options or ScanOptions(backend=self.backend)
+        sched = self.scheduler
+        reqs = []
+        for name, img in items:
+            reqs.append(sched.submit(
+                self._image_request(sched, name, img, options),
+                block=True))
+        out = []
+        for (name, _), req in zip(items, reqs):
+            try:
+                out.append(req.result())
+            except (SchedError, OSError, ValueError) as e:
+                out.append(BatchScanResult(name=name, error=str(e)))
+        self.last_stats = {"images": len(items),
+                           "sched": sched.stats()}
+        for k, v in self.last_stats["sched"].items():
+            if k.endswith("_s") or k == "overlap_ratio":
+                self.last_stats[k] = v
+        return out
+
+    def submit_path(self, path: str,
+                    options: Optional[ScanOptions] = None):
+        """Serving-mode entry: enqueue ONE image scan through the
+        scheduler and return its ScanRequest future (``.result()``
+        blocks; raises QueueFullError on backpressure). The batch
+        composition is the scheduler's business — concurrent
+        submitters share device dispatches."""
+        options = options or ScanOptions(backend=self.backend)
+        sched = self.scheduler
+        return sched.submit(
+            self._image_request(sched, path, None, options))
+
+    def _image_request(self, sched, name: str, image, options):
+        from ..sched import AnalyzedWork, ScanRequest
+
+        scan_secrets = "secret" in options.security_checks
+
+        def analyze(req):
+            img = image if image is not None else load_image(name)
+            opt = self._image_opt(scan_secrets)
+            a = _SchedImageArtifact(img, self.cache, opt)
+            # register pending blob writes BEFORE the analyzed blobs
+            # land in the cache (the _batch_secrets hook fires between
+            # analysis and put_blob), so a concurrent request can
+            # never observe an unpatched blob without also seeing the
+            # dependency that guards it
+            a._sched = sched
+            a._sched_req = req
+            ref = a.inspect()
+            a.reference = ref
+            scanner = LocalScanner(self.cache, self.store)
+            prepared = scanner.prepare(
+                ScanTarget(name=ref.name, artifact_id=ref.id,
+                           blob_ids=ref.blob_ids), options)
+            candidates = []
+            patch = None
+            deps = []
+            if scan_secrets:
+                # collected paths already carry the image '/' prefix
+                candidates = [(path, content)
+                              for _, path, content in a.collected]
+                deps = sched.blob_deps(ref.blob_ids, req)
+                if a.collected:
+                    patch = _make_patch(self.cache, a)
+
+            def finish(found, detected):
+                if scan_secrets:
+                    from ..applier import merge_layer_secrets
+                    blobs = [self.cache.get_blob(b)
+                             for b in ref.blob_ids]
+                    prepared.detail.secrets = \
+                        merge_layer_secrets(blobs)
+                results, os_found = scanner.finish(prepared,
+                                                   detected)
+                return BatchScanResult(
+                    name=ref.name,
+                    report=Report(
+                        artifact_name=ref.name,
+                        artifact_type="container_image",
+                        metadata=Metadata(
+                            os=os_found,
+                            image_id=ref.image_metadata.id,
+                            diff_ids=ref.image_metadata.diff_ids,
+                            repo_tags=ref.image_metadata.repo_tags,
+                            image_config=ref.image_metadata
+                            .image_config,
+                        ),
+                        results=results,
+                    ))
+
+            return AnalyzedWork(candidates=candidates,
+                                jobs=prepared.jobs, patch=patch,
+                                finish=finish, deps=deps)
+
+        return ScanRequest(name=name or getattr(image, "name", ""),
+                           analyze=analyze,
+                           deadline_s=getattr(options, "deadline_s",
+                                              0.0) or 0.0)
 
     def _scan_images(self, images: list,
                      options: Optional[ScanOptions] = None) -> list:
@@ -90,7 +259,7 @@ class BatchScanRunner:
         # ---- phase 1: analyze missing layers, collect candidates ----
         t0 = _time.perf_counter()
         artifacts = []
-        opt = ArtifactOption(scan_secrets=scan_secrets)
+        opt = self._image_opt(scan_secrets)
         for img in images:
             a = _CollectingImageArtifact(img, self.cache, opt)
             a.reference = a.inspect()
@@ -201,9 +370,62 @@ class BatchScanRunner:
         walking, no analyzers: decode → name-join → ONE interval
         dispatch for the whole fleet against the resident advisory
         tables."""
+        if self.sched == "on":
+            return self._scan_boms_scheduled(boms, options)
         from ..utils import defer_gc
         with defer_gc():
             return self._scan_boms(boms, options)
+
+    def _scan_boms_scheduled(self, boms: list,
+                             options: Optional[ScanOptions] = None)\
+            -> list:
+        from ..sched import SchedError
+        options = options or ScanOptions(
+            backend=self.backend, security_checks=["vuln"])
+        sched = self.scheduler
+        reqs = [sched.submit(self._bom_request(name, data, options),
+                             block=True)
+                for name, data in boms]
+        out = []
+        for (name, _), req in zip(boms, reqs):
+            try:
+                out.append(req.result())
+            except (SchedError, ValueError) as e:
+                out.append(BatchScanResult(name=name, error=str(e)))
+        self.last_stats = {"sboms": len(boms),
+                           "sched": sched.stats()}
+        return out
+
+    def _bom_request(self, name: str, data: bytes, options):
+        from ..sched import AnalyzedWork, ScanRequest
+
+        def analyze(req):
+            from ..artifact.sbom import decode_to_blob
+            # a malformed document fails its own slot, never the
+            # fleet (ValueError resolves this request only)
+            atype, decoded, blob, blob_id = decode_to_blob(data)
+            self.cache.put_blob(blob_id, blob)
+            scanner = LocalScanner(self.cache, self.store)
+            prepared = scanner.prepare(
+                ScanTarget(name=name, artifact_id=blob_id,
+                           blob_ids=[blob_id]), options)
+
+            def finish(found, detected):
+                results, os_found = scanner.finish(prepared,
+                                                   detected)
+                return BatchScanResult(
+                    name=name,
+                    report=Report(artifact_name=name,
+                                  artifact_type=atype,
+                                  metadata=Metadata(os=os_found),
+                                  results=results,
+                                  cyclonedx=decoded.cyclonedx))
+
+            return AnalyzedWork(jobs=prepared.jobs, finish=finish)
+
+        return ScanRequest(name=name, analyze=analyze,
+                           deadline_s=getattr(options, "deadline_s",
+                                              0.0) or 0.0)
 
     def _scan_boms(self, boms: list,
                    options: Optional[ScanOptions] = None) -> list:
@@ -286,6 +508,52 @@ class _CollectingImageArtifact(ImageArtifact):
         self.collected = [(li, "/" + path, content)
                           for li, path, content in candidates]
         return {}
+
+
+class _SchedImageArtifact(_CollectingImageArtifact):
+    """Collecting artifact that additionally announces which cache
+    blobs this request will patch — registered with the scheduler
+    BEFORE put_blob runs, so concurrent requests sharing a layer
+    always see either the patched blob or the pending-write event."""
+
+    _sched = None
+    _sched_req = None
+
+    def _inspect_layers(self, todo, blob_ids, base):
+        self._sched_blob_ids = blob_ids
+        return super()._inspect_layers(todo, blob_ids, base)
+
+    def _batch_secrets(self, candidates: list) -> dict:
+        if candidates and self._sched is not None and \
+                self.opt.scan_secrets:
+            ids = sorted({self._sched_blob_ids[li]
+                          for li, _, _ in candidates})
+            self._sched.register_blob_writes(ids, self._sched_req)
+        return super()._batch_secrets(candidates)
+
+
+def _make_patch(cache, artifact):
+    """Per-request secret patch: map batch sieve results back to this
+    artifact's layers by the LOCAL candidate index and rewrite the
+    affected cached blobs (the one-artifact slice of _patch_blobs)."""
+
+    def patch(found: list) -> None:
+        by_layer: dict = {}
+        for idx, s in found:
+            li = artifact.collected[idx][0]
+            by_layer.setdefault(li, []).append(s)
+        for li, secrets in by_layer.items():
+            blob_id = artifact.reference.blob_ids[li]
+            blob = cache.get_blob(blob_id)
+            if blob is not None:
+                secrets.sort(key=lambda s: s.file_path)
+                for s in secrets:
+                    s.findings.sort(key=lambda f: (f.rule_id,
+                                                   f.start_line))
+                blob.secrets = secrets
+                cache.put_blob(blob_id, blob)
+
+    return patch
 
 
 def _patch_blobs(cache, artifacts, found) -> None:

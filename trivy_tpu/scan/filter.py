@@ -147,7 +147,47 @@ def _filter_vulns(vulns: list, sev_names: set, ignore_unfixed: bool,
         key = (v.vulnerability_id, v.pkg_name, v.pkg_path,
                v.installed_version)
         old = unique.get(key)
-        # shouldOverwrite: prefer the entry carrying a fix
-        if old is None or (not old.fixed_version and v.fixed_version):
-            unique[key] = v
+        unique[key] = v if old is None else _merge_duplicate(old, v)
     return list(unique.values())
+
+
+_REDHAT_SOURCES = {"redhat", "redhat-oval"}
+
+
+def _is_redhat(v) -> bool:
+    if getattr(v, "severity_source", "") == "redhat":
+        return True
+    ds = getattr(v, "data_source", None)
+    return ds is not None and \
+        getattr(ds, "id", "") in _REDHAT_SOURCES
+
+
+def _merge_duplicate(old, new):
+    """Duplicate (ID, pkg, path, version) handling. Red Hat pairs
+    get the reference detector's same-CVE merge (redhat.go uniqVulns:
+    several RHSAs can fix one CVE — report the NEWEST FixedVersion
+    per the rpm comparer and the UNION of vendor ids, so neither
+    advisory's RHSA link is dropped); everything else keeps
+    shouldOverwrite semantics — prefer the entry carrying a fix."""
+    if _is_redhat(old) and _is_redhat(new):
+        winner, loser = old, new
+        if old.fixed_version != new.fixed_version:
+            if not old.fixed_version:
+                winner, loser = new, old
+            elif new.fixed_version:
+                try:
+                    from ..vercmp import get_comparer
+                    rpm = get_comparer("rpm")
+                    if rpm.parse(new.fixed_version) > \
+                            rpm.parse(old.fixed_version):
+                        winner, loser = new, old
+                except ValueError:
+                    pass            # unparseable: keep first
+        if loser.vendor_ids:
+            winner.vendor_ids = sorted(
+                set(winner.vendor_ids) | set(loser.vendor_ids))
+        return winner
+    # shouldOverwrite: prefer the entry carrying a fix
+    if not old.fixed_version and new.fixed_version:
+        return new
+    return old

@@ -1,0 +1,23 @@
+"""Continuous-batching scan scheduler (docs/serving.md).
+
+Decouples request arrival from device dispatch: a bounded admission
+queue with per-request deadlines feeds a coalescer that aggregates
+work into padding-bucketed device batches, executed by a two-stage
+pipeline that overlaps host preprocessing of batch N+1 with device
+execution of batch N. Every later scaling piece (multi-host, async
+prefetch, cache warming) hangs off this subsystem.
+"""
+
+from .coalescer import Batch, Coalescer, SchedConfig
+from .metrics import LatencyHistogram, SchedMetrics
+from .queue import (AdmissionQueue, AnalyzedWork, DeadlineExceeded,
+                    QueueFullError, RequestCancelled, ScanRequest,
+                    SchedError, SchedulerClosed)
+from .scheduler import ScanScheduler
+
+__all__ = [
+    "AdmissionQueue", "AnalyzedWork", "Batch", "Coalescer",
+    "DeadlineExceeded", "LatencyHistogram", "QueueFullError",
+    "RequestCancelled", "ScanRequest", "ScanScheduler",
+    "SchedConfig", "SchedError", "SchedMetrics", "SchedulerClosed",
+]

@@ -1,0 +1,219 @@
+"""Scheduler metrics: queue depth, batch occupancy, padding waste,
+host/device overlap, per-phase latency histograms.
+
+Everything here is lock-protected counters — cheap enough to update
+on every request — snapshotted into one JSON-able dict that both the
+server's ``/metrics`` endpoint and the ``--sched-stats`` CLI dump
+serve verbatim.
+
+The overlap ratio is measured, not inferred: the device executor
+brackets every kernel batch with ``device_begin``/``device_end`` and
+every host worker brackets its work with ``host_begin``/``host_end``;
+an accumulator integrates the wall-clock during which the device was
+busy AND at least one host worker was busy. ``overlap_ratio =
+that / device_busy`` — 0 means the strict host→device ladder the
+round-5 mesh curve flattened on, 1 means the device never waited
+alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class LatencyHistogram:
+    """Fixed-bound latency histogram (seconds) with quantile
+    estimates by linear interpolation inside the winning bucket."""
+
+    BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BOUNDS) + 1)
+        self.total = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, b in enumerate(self.BOUNDS):
+            if v <= b:
+                break
+        else:
+            i = len(self.BOUNDS)
+        self.counts[i] += 1
+        self.total += 1
+        self.sum += v
+        if v > self.max:
+            self.max = v
+
+    def quantile(self, q: float) -> float:
+        if not self.total:
+            return 0.0
+        target = q * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= target and c:
+                lo = self.BOUNDS[i - 1] if i else 0.0
+                hi = self.BOUNDS[i] if i < len(self.BOUNDS) \
+                    else self.max
+                frac = (target - seen) / c
+                return lo + (hi - lo) * min(1.0, frac)
+            seen += c
+        return self.max
+
+    def to_dict(self) -> dict:
+        mean = self.sum / self.total if self.total else 0.0
+        return {
+            "count": self.total,
+            "mean_s": round(mean, 6),
+            "p50_s": round(self.quantile(0.50), 6),
+            "p90_s": round(self.quantile(0.90), 6),
+            "p99_s": round(self.quantile(0.99), 6),
+            "max_s": round(self.max, 6),
+        }
+
+
+class SchedMetrics:
+    """One instance per scheduler; every method is thread-safe."""
+
+    PHASES = ("queue_wait", "analyze", "device", "finish", "request")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "rejected": 0, "timed_out": 0, "cancelled": 0,
+            "batches": 0,
+        }
+        self.hist = {p: LatencyHistogram() for p in self.PHASES}
+        # coalescer accounting
+        self._batch_items = 0
+        self._batch_bytes = 0
+        self._batch_jobs = 0
+        self._bucket_bytes = 0        # padded byte capacity booked
+        self._bucket_jobs = 0
+        # overlap accounting
+        self._host_active = 0
+        self._device_active = False
+        self._host_busy_s = 0.0
+        self._device_busy_s = 0.0
+        self._overlap_s = 0.0
+        self._both_since = None
+        self._depth_fn = None         # live queue-depth gauge
+        self._depth_max = 0
+        self._started = time.monotonic()
+
+    # --- counters / histograms ---
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self.hist[phase].observe(seconds)
+
+    def set_depth_gauge(self, fn) -> None:
+        self._depth_fn = fn
+
+    def note_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self._depth_max:
+                self._depth_max = depth
+
+    # --- coalescer accounting ---
+
+    def note_batch(self, items: int, cand_bytes: int, jobs: int,
+                   bucket_bytes: int, bucket_jobs: int) -> None:
+        with self._lock:
+            self.counters["batches"] += 1
+            self._batch_items += items
+            self._batch_bytes += cand_bytes
+            self._batch_jobs += jobs
+            self._bucket_bytes += bucket_bytes
+            self._bucket_jobs += bucket_jobs
+
+    # --- overlap accounting ---
+
+    def _update_both(self, now: float) -> None:
+        both = self._device_active and self._host_active > 0
+        if both and self._both_since is None:
+            self._both_since = now
+        elif not both and self._both_since is not None:
+            self._overlap_s += now - self._both_since
+            self._both_since = None
+
+    def host_begin(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._host_active += 1
+            self._update_both(now)
+        return now
+
+    def host_end(self, t0: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._host_active -= 1
+            self._host_busy_s += now - t0
+            self._update_both(now)
+
+    def device_begin(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._device_active = True
+            self._update_both(now)
+        return now
+
+    def device_end(self, t0: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._device_active = False
+            self._device_busy_s += now - t0
+            self._update_both(now)
+
+    # --- snapshot ---
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            overlap = self._overlap_s
+            if self._both_since is not None:
+                overlap += now - self._both_since
+            batches = self.counters["batches"]
+            occupancy = (
+                self._batch_bytes / self._bucket_bytes
+                if self._bucket_bytes else
+                (self._batch_jobs / self._bucket_jobs
+                 if self._bucket_jobs else 0.0))
+            padding_waste = 1.0 - occupancy if batches else 0.0
+            out = {
+                "counters": dict(self.counters),
+                "queue_depth": (self._depth_fn()
+                                if self._depth_fn else 0),
+                "queue_depth_max": self._depth_max,
+                "batch": {
+                    "count": batches,
+                    "items_total": self._batch_items,
+                    "mean_items": round(
+                        self._batch_items / batches, 2)
+                    if batches else 0.0,
+                    "candidate_bytes": self._batch_bytes,
+                    "interval_jobs": self._batch_jobs,
+                    "bucket_bytes": self._bucket_bytes,
+                    "bucket_jobs": self._bucket_jobs,
+                    "occupancy": round(occupancy, 4),
+                    "padding_waste": round(padding_waste, 4),
+                },
+                "host_busy_s": round(self._host_busy_s, 4),
+                "device_busy_s": round(self._device_busy_s, 4),
+                "overlap_s": round(overlap, 4),
+                "overlap_ratio": round(
+                    overlap / self._device_busy_s, 4)
+                if self._device_busy_s else 0.0,
+                "uptime_s": round(now - self._started, 2),
+                "latency": {p: h.to_dict()
+                            for p, h in self.hist.items()},
+            }
+        return out

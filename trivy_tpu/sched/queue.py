@@ -1,0 +1,214 @@
+"""Admission queue: bounded, deadline-aware, cancellable.
+
+The queue is the backpressure surface of the scheduler — ``put``
+never blocks callers that asked for serving semantics; when the bound
+is hit it raises the typed :class:`QueueFullError` so the RPC layer
+can answer 503 (the client's retry-with-backoff treats that as
+transient, exactly the reference's twirp.Unavailable loop). Batch
+callers that WANT to wait (the CLI fleet path feeding 512 images into
+a 256-slot queue) pass ``block=True``.
+
+A :class:`ScanRequest` is a one-shot future plus the two host
+callables the pipeline executor runs on its behalf:
+
+* ``analyze()`` → :class:`AnalyzedWork` — phase-1 host work (image
+  load/analyze/squash/join) run in the worker pool;
+* ``work.finish(sieve_found, detected)`` → result — phase-3 host
+  work (secret patch, result assembly) run in the worker pool after
+  the device batch resolves.
+
+Deadlines are absolute ``time.monotonic()`` instants. An expired
+request is resolved with :class:`DeadlineExceeded` at whatever stage
+notices first (admission pop, coalescer flush, or ``result()``
+itself) — a deadline NEVER hangs, and never cancels device work
+already in flight (the batch completes; the late result is simply
+discarded).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class SchedError(RuntimeError):
+    """Base class for scheduler errors."""
+
+
+class QueueFullError(SchedError):
+    """Admission queue at capacity — back off and retry."""
+
+
+class DeadlineExceeded(SchedError):
+    """The request's deadline passed before completion."""
+
+
+class RequestCancelled(SchedError):
+    """The request was cancelled before completion."""
+
+
+class SchedulerClosed(SchedError):
+    """submit() after close()."""
+
+
+@dataclass
+class AnalyzedWork:
+    """What one request contributes to a device batch."""
+
+    candidates: list = field(default_factory=list)  # [(path, bytes)]
+    jobs: list = field(default_factory=list)        # interval jobs
+    patch: Optional[Callable] = None   # (found)->None secret patch
+    finish: Optional[Callable] = None  # (found, detected)->result
+    deps: list = field(default_factory=list)  # events to await
+    group: str = ""                    # batch-compatibility key
+
+    @property
+    def candidate_bytes(self) -> int:
+        return sum(len(c) for _, c in self.candidates)
+
+
+class ScanRequest:
+    """One unit of admission: a name, the analyze callable, a
+    deadline, and a one-shot result slot."""
+
+    def __init__(self, name: str, analyze: Callable,
+                 deadline_s: float = 0.0, group: str = "",
+                 on_done: Optional[Callable] = None):
+        self.name = name
+        self.analyze = analyze
+        self.group = group
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + deadline_s
+                         if deadline_s and deadline_s > 0 else None)
+        self.on_done = on_done
+        self.work: Optional[AnalyzedWork] = None
+        # patched_event: set once this request's secret patch landed
+        # in the cache — other requests sharing a layer blob wait on
+        # it before their final secret merge
+        self.patched_event = threading.Event()
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._lock = threading.Lock()
+
+    # --- resolution (exactly-once) ---
+
+    def _resolve(self, result=None,
+                 error: Optional[BaseException] = None) -> bool:
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._result = result
+            self._error = error
+            self._done.set()
+        # a dropped request must never wedge dependents
+        self.patched_event.set()
+        if self.on_done is not None:
+            try:
+                self.on_done(self)
+            except Exception:       # noqa: BLE001 — never propagate
+                pass
+        return True
+
+    def set_result(self, result) -> bool:
+        return self._resolve(result=result)
+
+    def set_error(self, error: BaseException) -> bool:
+        return self._resolve(error=error)
+
+    def cancel(self) -> None:
+        """Best-effort: marks the request; a stage that has not yet
+        started work on it resolves it with RequestCancelled."""
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now or time.monotonic()) >= self.deadline
+
+    def remaining(self, default: float = 60.0) -> float:
+        if self.deadline is None:
+            return default
+        return max(0.0, self.deadline - time.monotonic())
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until resolution (or the deadline) and return the
+        result, raising the typed error on failure. With a deadline
+        set this can never hang: it waits at most until the deadline
+        plus a small grace and then raises DeadlineExceeded."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0,
+                          self.deadline - time.monotonic()) + 0.25
+        if not self._done.wait(timeout):
+            raise DeadlineExceeded(
+                f"scan {self.name!r}: deadline exceeded")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class AdmissionQueue:
+    """Bounded FIFO with typed-overflow put and blocking get."""
+
+    def __init__(self, maxsize: int = 256):
+        self.maxsize = max(1, int(maxsize))
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def put(self, req: ScanRequest, block: bool = False,
+            timeout: Optional[float] = None) -> None:
+        with self._cv:
+            if self._closed:
+                raise SchedulerClosed("scheduler is closed")
+            if not block and len(self._items) >= self.maxsize:
+                raise QueueFullError(
+                    f"scan queue full ({self.maxsize} pending)")
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
+            while len(self._items) >= self.maxsize:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise QueueFullError(
+                        f"scan queue full ({self.maxsize} pending)")
+                self._cv.wait(remaining)
+                if self._closed:
+                    raise SchedulerClosed("scheduler is closed")
+            self._items.append(req)
+            self._cv.notify_all()
+
+    def get(self, timeout: Optional[float] = None)\
+            -> Optional[ScanRequest]:
+        with self._cv:
+            if not self._items:
+                self._cv.wait(timeout)
+            if not self._items:
+                return None
+            req = self._items.popleft()
+            self._cv.notify_all()
+            return req
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,411 @@
+"""The continuous-batching scan scheduler.
+
+Topology (docs/serving.md has the full picture)::
+
+    sources (RPC Scan / CLI fleet) ──submit──▶ AdmissionQueue
+        │ intake thread (deadline sweep)
+        ▼
+    host worker pool ──analyze()──▶ Coalescer (volume buckets)
+        │ device executor thread (one, serializes kernel work)
+        ▼
+    sieve dispatch ─▶ interval dispatch ─▶ sieve collect
+        │ per-request finish() back on the worker pool
+        ▼
+    request futures resolve
+
+The device executor owns ALL kernel dispatch, so device work is
+serialized (one XLA stream, no interleaved compilation); the worker
+pool runs every host phase. While the device chews batch N, the pool
+analyzes batch N+1 and assembles batch N-1 — the host/device overlap
+the round-5 mesh curve lacked. Iteration-level scheduling à la
+Orca/vLLM: requests join whichever batch is forming when their host
+analysis lands, not the batch they arrived with.
+
+Cross-request consistency: two concurrent requests can share a layer
+blob (fleets share file trees). A request that analyzed a layer will
+patch that blob's secrets only when its batch's sieve resolves; any
+OTHER request whose final merge reads that blob must wait for the
+patch. The scheduler tracks pending blob writes and hands each
+request the set of patch events it depends on — the device thread
+alone resolves them, so there is no cycle to deadlock on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..utils import get_logger
+from .coalescer import Batch, Coalescer, SchedConfig
+from .metrics import SchedMetrics
+from .queue import (AdmissionQueue, DeadlineExceeded, QueueFullError,
+                    RequestCancelled, ScanRequest, SchedulerClosed)
+
+log = get_logger("sched")
+
+
+class ScanScheduler:
+    """Owns the queue, the coalescer, the worker pool, and the
+    device executor. One instance per process serves every request
+    source; ``group`` keys keep incompatible dispatches apart."""
+
+    def __init__(self, config: Optional[SchedConfig] = None,
+                 backend: str = "tpu", mesh=None,
+                 secret_scanner=None):
+        self.config = config or SchedConfig()
+        self.backend = backend
+        self.mesh = mesh
+        self.secret_scanner = secret_scanner
+        self.metrics = SchedMetrics()
+        self.queue = AdmissionQueue(self.config.max_queue)
+        self.metrics.set_depth_gauge(self.queue.depth)
+        self.coalescer = Coalescer(self.config)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._threads: list = []
+        self._cv = threading.Condition()
+        self._analyzing = 0
+        self._kernel_s = 0.0      # interval-kernel wall (all batches)
+        self._running = False
+        self._lock = threading.Lock()
+        # blob id → patch event of the request that will write it
+        self._blob_lock = threading.Lock()
+        self._pending_blobs: dict = {}
+
+    # --- lifecycle ---
+
+    def start(self) -> "ScanScheduler":
+        with self._lock:
+            if self._running:
+                return self
+            if self.queue.closed:
+                # a closed scheduler never revives — restarting the
+                # threads against a permanently closed queue would
+                # only leak them
+                raise SchedulerClosed("scheduler is closed")
+            self._running = True
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, self.config.workers),
+                thread_name_prefix="sched-host")
+            for name, fn in (("sched-intake", self._intake_loop),
+                             ("sched-device", self._device_loop)):
+                t = threading.Thread(target=fn, name=name,
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def close(self, wait: bool = True) -> None:
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+        self.queue.close()
+        with self._cv:
+            self._cv.notify_all()
+        # anything not yet handed to the device fails typed
+        while True:
+            req = self.queue.get(timeout=0)
+            if req is None:
+                break
+            self._fail(req, SchedulerClosed("scheduler closed"))
+        for req in self.coalescer.drain():
+            self._fail(req, SchedulerClosed("scheduler closed"))
+        if self._pool is not None:
+            self._pool.shutdown(wait=wait)
+        # a second drain AFTER the pool settles: an _analyze that was
+        # mid-flight during the first drain may have added its
+        # request to the coalescer since — without this, that future
+        # would never resolve (and an RPC adapter's on_done release
+        # would never run)
+        for req in self.coalescer.drain():
+            self._fail(req, SchedulerClosed("scheduler closed"))
+        for t in self._threads:
+            t.join(timeout=5 if wait else 0)
+        self._threads = []
+
+    def __enter__(self) -> "ScanScheduler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- submission ---
+
+    def submit(self, request: ScanRequest,
+               block: bool = False) -> ScanRequest:
+        """Admit one request. Raises QueueFullError (backpressure)
+        unless ``block``, SchedulerClosed after close()."""
+        if not self._running:
+            self.start()
+        if request.deadline is None and \
+                self.config.default_deadline_s > 0:
+            request.deadline = (request.submitted_at +
+                                self.config.default_deadline_s)
+        request.group = request.group or self.backend
+        try:
+            self.queue.put(request, block=block)
+        except QueueFullError:
+            self.metrics.inc("rejected")
+            raise
+        self.metrics.inc("submitted")
+        self.metrics.note_depth(self.queue.depth())
+        with self._cv:
+            self._cv.notify_all()
+        return request
+
+    def stats(self) -> dict:
+        out = self.metrics.snapshot()
+        out["config"] = {
+            "max_queue": self.config.max_queue,
+            "workers": self.config.workers,
+            "flush_timeout_s": self.config.flush_timeout_s,
+            "max_batch_bytes": self.config.max_batch_bytes,
+            "max_batch_jobs": self.config.max_batch_jobs,
+            "max_batch_items": self.config.max_batch_items,
+        }
+        out["backend"] = self.backend
+        with self._lock:
+            out["interval_kernel_s"] = round(self._kernel_s, 4)
+        return out
+
+    # --- cross-request blob dependencies (called from analyze) ---
+
+    def register_blob_writes(self, blob_ids: list,
+                             request: ScanRequest) -> None:
+        """This request's sieve results will patch these cache
+        blobs; requests reading them must wait for the patch."""
+        with self._blob_lock:
+            for b in blob_ids:
+                self._pending_blobs[b] = request.patched_event
+        request._registered_blobs = list(blob_ids)
+
+    def blob_deps(self, blob_ids: list,
+                  request: ScanRequest) -> list:
+        """Patch events (other requests') this request's final
+        secret merge depends on."""
+        with self._blob_lock:
+            out = []
+            for b in blob_ids:
+                ev = self._pending_blobs.get(b)
+                if ev is not None and \
+                        ev is not request.patched_event:
+                    out.append(ev)
+            return out
+
+    def _clear_blob_writes(self, request: ScanRequest) -> None:
+        blobs = getattr(request, "_registered_blobs", ())
+        with self._blob_lock:
+            for b in blobs:
+                if self._pending_blobs.get(b) is \
+                        request.patched_event:
+                    del self._pending_blobs[b]
+
+    # --- resolution helpers ---
+
+    def _complete(self, req: ScanRequest, result) -> None:
+        self._clear_blob_writes(req)
+        if req.set_result(result):
+            self.metrics.inc("completed")
+            self.metrics.observe(
+                "request", time.monotonic() - req.submitted_at)
+
+    def _fail(self, req: ScanRequest, err: BaseException) -> None:
+        self._clear_blob_writes(req)
+        if req.set_error(err):
+            if isinstance(err, DeadlineExceeded):
+                self.metrics.inc("timed_out")
+            elif isinstance(err, RequestCancelled):
+                self.metrics.inc("cancelled")
+            else:
+                self.metrics.inc("failed")
+
+    def _sweep(self, req: ScanRequest) -> bool:
+        """True if the request is dead (expired/cancelled) and was
+        resolved here."""
+        if req.cancelled:
+            self._fail(req, RequestCancelled(
+                f"scan {req.name!r}: cancelled"))
+            return True
+        if req.expired():
+            self._fail(req, DeadlineExceeded(
+                f"scan {req.name!r}: deadline exceeded"))
+            return True
+        return False
+
+    # --- stage 1: intake + host analyze ---
+
+    def _intake_loop(self) -> None:
+        # the admission queue is the ONLY wait buffer: intake stops
+        # pulling once the pool has a small prefetch window in
+        # flight, so a saturated pool backs pressure up into the
+        # bounded queue (and from there into typed 503s) instead of
+        # an unbounded executor backlog
+        prefetch = max(2, self.config.workers * 2)
+        while self._running:
+            with self._cv:
+                while self._running and self._analyzing >= prefetch:
+                    self._cv.wait(0.05)
+            if not self._running:
+                break
+            req = self.queue.get(timeout=0.05)
+            if req is None:
+                continue
+            self.metrics.observe(
+                "queue_wait", time.monotonic() - req.submitted_at)
+            if self._sweep(req):
+                continue
+            with self._cv:
+                self._analyzing += 1
+            try:
+                self._pool.submit(self._analyze, req)
+            except RuntimeError:     # pool shut down under us
+                with self._cv:
+                    self._analyzing -= 1
+                self._fail(req, SchedulerClosed("scheduler closed"))
+
+    def _analyze(self, req: ScanRequest) -> None:
+        t0 = self.metrics.host_begin()
+        try:
+            if not self._sweep(req):
+                req.work = req.analyze(req)
+                req.work.group = req.work.group or req.group
+                self.coalescer.add(req)
+        except Exception as e:       # noqa: BLE001
+            log.warning("analyze %r failed: %r", req.name, e)
+            self._fail(req, e)
+        finally:
+            self.metrics.host_end(t0)
+            self.metrics.observe("analyze", time.monotonic() - t0)
+            with self._cv:
+                self._analyzing -= 1
+                self._cv.notify_all()
+
+    # --- stage 2: device executor ---
+
+    def _upstream_idle(self) -> bool:
+        return self.queue.depth() == 0 and self._analyzing == 0
+
+    def _device_loop(self) -> None:
+        wait_s = min(0.1, max(0.005,
+                              self.config.flush_timeout_s / 2))
+        while self._running:
+            group = self.coalescer.ready_group(self._upstream_idle())
+            if group is None:
+                with self._cv:
+                    self._cv.wait(wait_s)
+                continue
+            batch = self.coalescer.take(group)
+            if batch is None or not batch.requests:
+                continue
+            try:
+                self._execute(batch)
+            except Exception as e:   # noqa: BLE001
+                log.warning("batch execution failed: %r", e)
+                for r in batch.requests:
+                    self._fail(r, e)
+        # drain on shutdown
+        for req in self.coalescer.drain():
+            self._fail(req, SchedulerClosed("scheduler closed"))
+
+    def _execute(self, batch: Batch) -> None:
+        from ..detect.batch import dispatch_jobs
+
+        reqs = [r for r in batch.requests if not self._sweep(r)]
+        if not reqs:
+            return
+        self.metrics.note_batch(
+            len(reqs), batch.candidate_bytes, batch.jobs,
+            batch.bucket_bytes, batch.bucket_jobs)
+
+        # flatten sieve candidates; owner map brings results home by
+        # ENTRY INDEX (paths repeat across images — see secret.batch)
+        files, owner, local = [], [], []
+        for i, r in enumerate(reqs):
+            for j, (path, content) in enumerate(r.work.candidates):
+                files.append((path, content))
+                owner.append(i)
+                local.append(j)
+
+        t0 = self.metrics.device_begin()
+        try:
+            sieve_handle = None
+            if files and self.secret_scanner is not None:
+                # async enqueue: the device sieves while the interval
+                # dispatch below compiles/queues behind it
+                sieve_handle = self.secret_scanner.dispatch_files(
+                    files)
+
+            all_jobs = []
+            for i, r in enumerate(reqs):
+                for job in r.work.jobs:
+                    job.payload = (i, job.payload)
+                    all_jobs.append(job)
+            detected_by: dict = {}
+            if all_jobs:
+                kstats: dict = {}    # per-batch sink, not the global
+                for i, payload in dispatch_jobs(
+                        all_jobs, backend=batch.group or self.backend,
+                        mesh=self.mesh, stats=kstats):
+                    detected_by.setdefault(i, []).append(payload)
+                with self._lock:
+                    self._kernel_s += kstats.get("device_s", 0.0)
+
+            found_by: dict = {}
+            if sieve_handle is not None:
+                for idx, secret in self.secret_scanner.collect(
+                        sieve_handle):
+                    found_by.setdefault(owner[idx], []).append(
+                        (local[idx], secret))
+        finally:
+            self.metrics.device_end(t0)
+        self.metrics.observe("device", time.monotonic() - t0)
+
+        # patch + event-set happen HERE, on the device thread, so
+        # every patch event is resolved without touching the worker
+        # pool — a finish waiting on another request's patch can
+        # never starve the work that would satisfy it
+        for i, r in enumerate(reqs):
+            found = found_by.get(i, [])
+            try:
+                if r.work.patch is not None:
+                    r.work.patch(found)
+            except Exception as e:   # noqa: BLE001
+                log.warning("patch %r failed: %r", r.name, e)
+                self._fail(r, e)
+                continue
+            r.patched_event.set()
+            self._clear_blob_writes(r)
+            try:
+                self._pool.submit(self._finish, r, found,
+                                  detected_by.get(i, []))
+            except RuntimeError:     # pool shut down under us
+                self._fail(r, SchedulerClosed("scheduler closed"))
+
+    # --- stage 3: host finish ---
+
+    def _finish(self, req: ScanRequest, found: list,
+                detected: list) -> None:
+        t0 = self.metrics.host_begin()
+        try:
+            work = req.work
+            for ev in work.deps:
+                # deps are resolved by the device thread; they cannot
+                # wait on this request, so a bounded wait only guards
+                # against scheduler shutdown mid-flight
+                while not ev.wait(timeout=1.0):
+                    if not self._running:
+                        self._fail(req, SchedulerClosed(
+                            "scheduler closed"))
+                        return
+                    if self._sweep(req):
+                        return
+            result = work.finish(found, detected)
+            self._complete(req, result)
+        except Exception as e:       # noqa: BLE001
+            log.warning("finish %r failed: %r", req.name, e)
+            self._fail(req, e)
+        finally:
+            self.metrics.host_end(t0)
+            self.metrics.observe("finish", time.monotonic() - t0)
