@@ -679,10 +679,107 @@ def bench_serving() -> dict:
         }
 
 
+N_FAULT_IMAGES = 64
+
+
+def bench_faults() -> dict:
+    """Robustness under the standard outage scenario
+    (docs/robustness.md): the 64-image fleet scanned with a cache
+    outage long enough to trip the circuit breaker and recover, one
+    poisoned image (device dispatch fails whenever it rides a
+    batch), and one transient device error. Records degraded-mode
+    throughput vs the fault-free run, the breaker's recovery time,
+    and the quarantine counters — the acceptance gate: healthy
+    targets byte-identical, the poisoned target explicitly
+    degraded, zero unhandled exceptions."""
+    import tempfile
+
+    from trivy_tpu.artifact.cache import MemoryCache
+    from trivy_tpu.artifact.resilient import (CircuitBreaker,
+                                              ResilientCache)
+    from trivy_tpu.faults import (FaultInjector, FaultyCache,
+                                  parse_fault_spec)
+    from trivy_tpu.runtime import BatchScanRunner
+
+    cfg = _sched_cfg()
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = make_fleet(tmp, N_FAULT_IMAGES)
+        store = make_store()
+
+        # warm-up + fault-free anchor (fresh runner, cold cache)
+        warm = BatchScanRunner(store=store, backend="tpu",
+                               sched=cfg)
+        warm.scan_paths(paths)
+        warm.close()
+        runner = BatchScanRunner(store=store, backend="tpu",
+                                 sched=_sched_cfg())
+        t0 = time.perf_counter()
+        baseline = runner.scan_paths(paths)
+        clean_s = time.perf_counter() - t0
+        runner.close()
+
+        # the standard outage: poison img7, a cache outage that
+        # trips the breaker (3 consecutive failures) and then ends
+        # after a few half-open probes burn the remaining fail
+        # budget — so the run records a real recovery time — plus 1
+        # transient device error; seeded so the run is reproducible
+        spec = parse_fault_spec(
+            "standard-outage:poison=img7.tar,cache_fail_ops=6")
+        inj = FaultInjector(spec)
+        breaker = CircuitBreaker(fail_threshold=3, cooldown_s=0.1)
+        cache = ResilientCache(FaultyCache(MemoryCache(), inj),
+                               breaker=breaker)
+        runner = BatchScanRunner(store=store, backend="tpu",
+                                 cache=cache, sched=_sched_cfg(),
+                                 fault_injector=inj)
+        t0 = time.perf_counter()
+        results = runner.scan_paths(paths)
+        degraded_s = time.perf_counter() - t0
+        sched_counters = runner.scheduler.metrics.snapshot()[
+            "counters"]
+        runner.close()
+
+        # acceptance: healthy targets byte-identical to fault-free,
+        # the poisoned one degraded with causes, nothing failed
+        healthy = [r for r in results if "img7.tar" not in r.name]
+        healthy_base = [r for r in baseline
+                        if "img7.tar" not in r.name]
+        assert _norm(healthy) == _norm(healthy_base), \
+            "healthy targets diverged under faults"
+        statuses = {r.name: r.status for r in results}
+        degraded = [n for n, s in statuses.items()
+                    if s == "degraded"]
+        failed = [n for n, s in statuses.items() if s == "failed"]
+        assert not failed, f"unexpected failed slots: {failed}"
+        assert any("img7.tar" in n for n in degraded), \
+            f"poisoned image not degraded: {statuses}"
+
+        breaker_stats = cache.breaker_stats()
+        recoveries = breaker_stats["breaker"]["recoveries"]
+        return {
+            "images": len(paths),
+            "fault_free_ips": round(len(paths) / clean_s, 2),
+            "degraded_ips": round(len(paths) / degraded_s, 2),
+            "degraded_cost": round(degraded_s / clean_s, 3),
+            "degraded_targets": len(degraded),
+            "failed_targets": len(failed),
+            "breaker_trips": breaker_stats["breaker"]["trips"],
+            "breaker_recovery_s": (recoveries[0]["recovered_s"]
+                                   if recoveries else None),
+            "cache_fallback_ops": breaker_stats["fallback_ops"],
+            "quarantined": sched_counters.get("quarantined", 0),
+            "batch_bisects": sched_counters.get("batch_bisects", 0),
+            "host_fallbacks": sched_counters.get("host_fallbacks",
+                                                 0),
+            "faults_injected": inj.stats(),
+        }
+
+
 def _run_config(cfg: str) -> dict:
     return {"images": bench_images, "sboms": bench_sboms,
             "mesh": bench_mesh_scaling,
-            "serving": bench_serving}[cfg]()
+            "serving": bench_serving,
+            "faults": bench_faults}[cfg]()
 
 
 def _subprocess_config(cfg: str) -> dict:
@@ -727,6 +824,7 @@ def main() -> None:
     sbom_runs = [_subprocess_config("sboms") for _ in range(RUNS)]
     serving = _subprocess_config("serving")
     mesh = _subprocess_config("mesh")
+    faults = _subprocess_config("faults")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -750,6 +848,7 @@ def main() -> None:
         "sbom_bench": sboms,
         "serving": serving,
         "mesh_scaling": mesh,
+        "faults": faults,
     }))
 
 

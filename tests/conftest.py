@@ -30,3 +30,16 @@ def mesh8():
     from trivy_tpu.parallel.mesh import make_mesh
     assert len(jax.devices()) >= 8
     return make_mesh(8)
+
+
+@pytest.fixture
+def make_faults():
+    """Build a deterministic FaultInjector from a --fault-spec
+    string, e.g. ``make_faults("poison-image:poison=img3.tar")``
+    (docs/robustness.md has the scenario list)."""
+    from trivy_tpu.faults import FaultInjector, parse_fault_spec
+
+    def make(spec: str):
+        return FaultInjector(parse_fault_spec(spec))
+
+    return make

@@ -465,7 +465,8 @@ class TestServing:
         try:
             m = json.loads(urllib.request.urlopen(
                 url + "/metrics").read())
-            assert m == {"scheduler": "off"}
+            assert m["scheduler"] == "off"
+            assert "idempotency" in m and not m["draining"]
         finally:
             srv.close()
             httpd.shutdown()

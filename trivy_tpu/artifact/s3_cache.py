@@ -42,6 +42,16 @@ class S3Error(ConnectionError):
     pass
 
 
+class S3IntegrityError(S3Error):
+    """The cache is INCONSISTENT (e.g. an .index marker without its
+    object), not unreachable. ``integrity`` marks it for the circuit
+    breaker (artifact/resilient.py): tripping open on a healthy-but-
+    inconsistent bucket would hide the actionable message and take
+    the whole cache offline, so the breaker re-raises these."""
+
+    integrity = True
+
+
 class S3Client:
     """Just enough S3 REST: PUT/GET/HEAD/DELETE object."""
 
@@ -228,7 +238,7 @@ class S3Cache:
         key = self._key(bucket, id_)
         status, _ = self.client.request("HEAD", key)
         if status == 404:
-            raise S3Error(
+            raise S3IntegrityError(
                 f"s3 cache inconsistent: {key}.index exists but "
                 f"the object is missing (run delete_blobs or evict "
                 f"the marker)")

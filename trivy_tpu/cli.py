@@ -148,6 +148,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="admission queue bound (backpressure)")
         sp.add_argument("--sched-workers", type=int, default=4,
                         help="host worker pool size")
+        sp.add_argument("--fault-spec", default="",
+                        help="inject deterministic faults "
+                        "(docs/robustness.md): a scenario name "
+                        "(cache-outage, poison-image, "
+                        "device-transient, rpc-flaky, slow-host, "
+                        "standard-outage ...) optionally followed "
+                        "by :key=value overrides, e.g. "
+                        "poison-image:poison=img7.tar")
         sp.add_argument("--config", "-c", default="",
                         help="config file (default: trivy.yaml)")
         sp.add_argument("--server", default="",
@@ -295,6 +303,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="default per-request deadline "
                      "(Go duration, e.g. 30s; requests may "
                      "override via body deadline_s)")
+    srv.add_argument("--fault-spec", default="",
+                     help="inject deterministic faults into the "
+                     "server (docs/robustness.md)")
+    srv.add_argument("--drain-timeout", type=float, default=30.0,
+                     help="SIGTERM graceful-drain bound in seconds "
+                     "(in-flight scans finish, new work gets 503)")
 
     plug = sub.add_parser("plugin", help="manage plugins")
     plugsub = plug.add_subparsers(dest="plugin_command")
@@ -449,6 +463,8 @@ def _dispatch(args) -> int:
 def run_aws(args) -> int:
     """ref pkg/cloud/aws/commands/run.go over cached account state."""
     from .cloud import load_account_state, scan_account
+    if _reject_unwired_fault_spec(args):
+        return 2
     try:
         state = load_account_state(args.account_state)
     except (OSError, ValueError) as e:
@@ -584,6 +600,8 @@ def run_k8s(args) -> int:
     """ref pkg/k8s/commands/run.go:58-151 — enumerate, scan, render."""
     from .k8s import K8sScanner, ManifestClient
     from .k8s.report import k8s_failed, write_k8s_report
+    if _reject_unwired_fault_spec(args):
+        return 2
     if not os.path.exists(args.target):
         print(f"error: no such path: {args.target}", file=sys.stderr)
         return 1
@@ -684,10 +702,13 @@ def run_server(args) -> int:
                         token=args.auth_token,
                         token_header=args.token_header,
                         sched=sched)
+    server.fault_injector = _fault_injector(args)
     print(f"trivy-tpu server listening on {args.listen}")
     serve_forever(host or "127.0.0.1", int(port), server,
                   db_watch_prefix=args.compiled_db,
-                  db_watch_interval_s=args.db_watch_interval)
+                  db_watch_interval_s=args.db_watch_interval,
+                  drain_timeout_s=getattr(args, "drain_timeout",
+                                          30.0))
     return 0
 
 
@@ -947,18 +968,30 @@ def _custom_headers(args) -> dict:
 def _cache(args):
     if getattr(args, "server", ""):
         # client/server split: blobs push to the server's cache
-        # (ref run.go:296-299 NopCache(RemoteCache))
+        # (ref run.go:296-299 NopCache(RemoteCache)). Deliberately
+        # NOT behind ResilientCache: the reader of these blobs is
+        # the REMOTE server, so degrading a put into a local
+        # fallback would let the later Scan RPC silently scan with
+        # missing layers. The cache and scan RPCs share fate (same
+        # server), and the client's own backoff loop already covers
+        # transient failures — loud failure is the correct mode.
         from .rpc.client import RemoteCache
         return RemoteCache(args.server, token=args.auth_token,
                            token_header=args.token_header,
                            custom_headers=_custom_headers(args))
     backend = getattr(args, "cache_backend", "fs")
+    # remote backends go behind the circuit breaker: construction
+    # failures (bad URL, unreachable at startup) still fail the run
+    # fast, but a mid-scan outage degrades to the local fallback
+    # instead of killing the fleet (docs/robustness.md)
     if backend.startswith("redis://"):
         from .artifact.redis_cache import RedisCache
-        return RedisCache(backend)
+        from .artifact.resilient import ResilientCache
+        return ResilientCache(RedisCache(backend))
     if backend.startswith("s3://"):
         from .artifact.s3_cache import S3Cache
-        return S3Cache(backend)
+        from .artifact.resilient import ResilientCache
+        return ResilientCache(S3Cache(backend))
     if backend != "fs":
         raise ValueError(
             f"unsupported --cache-backend {backend!r} "
@@ -996,7 +1029,16 @@ def run_image(args) -> int:
     targets = args.target if isinstance(args.target, list) \
         else ([args.target] if args.target else [])
     if len(targets) > 1:
+        if args.input:
+            # silently dropping --input next to a target list would
+            # scan a different fleet than the user asked for
+            print("error: --input cannot be combined with multiple "
+                  "image targets; list the archive as a target "
+                  "instead", file=sys.stderr)
+            return 2
         return _run_image_batch(args, targets)
+    if _reject_unwired_fault_spec(args):
+        return 2
     target = targets[0] if targets else ""
     args.target = target
     path = args.input or target
@@ -1049,6 +1091,28 @@ def run_image(args) -> int:
     return _finish(args, report)
 
 
+def _fault_injector(args):
+    """--fault-spec → FaultInjector, or None. Parse errors fail the
+    run up front (ValueError is caught by main's clean-error path)."""
+    spec = getattr(args, "fault_spec", "")
+    if not spec:
+        return None
+    from .faults import FaultInjector, parse_fault_spec
+    return FaultInjector(parse_fault_spec(spec))
+
+
+def _reject_unwired_fault_spec(args) -> bool:
+    """True (and an error printed) when --fault-spec was given on a
+    path that has no injection sites — a clean run there would be
+    false confidence, not a passed drill (docs/robustness.md)."""
+    if getattr(args, "fault_spec", ""):
+        print("error: --fault-spec is wired into multi-target "
+              "image scans and the server; this command would "
+              "inject nothing", file=sys.stderr)
+        return True
+    return False
+
+
 def _sched_config(args):
     from .sched import SchedConfig
     return SchedConfig(
@@ -1068,25 +1132,44 @@ def _run_image_batch(args, targets: list) -> int:
               "one target at a time against --server",
               file=sys.stderr)
         return 2
+    if args.format not in ("table", "json", "template"):
+        # per-slot writers would concatenate complete documents into
+        # one stream — invalid sarif/SBOM output; refuse up front
+        print(f"error: multi-image scans support table/json/"
+              f"template output, not {args.format}",
+              file=sys.stderr)
+        return 2
     checks = [c for c in args.security_checks.split(",") if c]
     store = _store(args) if "vuln" in checks else AdvisoryStore()
     opt = _artifact_option(args)
-    backend = "cpu-ref" if args.backend == "cpu-ref" \
-        else args.backend
+    backend = args.backend
+    injector = _fault_injector(args)
+    cache = _cache(args)
+    if injector is not None:
+        cache = injector.wrap_cache(cache)
     runner = BatchScanRunner(
-        store=store, cache=_cache(args), backend=backend,
+        store=store, cache=cache, backend=backend,
         secret_scanner=opt.secret_scanner,
         sched=("on" if args.sched == "on" else "off"),
         sched_config=_sched_config(args),
-        artifact_option=opt)
+        artifact_option=opt,
+        fault_injector=injector)
+    options = _scan_options(args)
+    if injector is not None and injector.spec.deadline_s > 0:
+        # deadline-storm scenario: the spec carries the per-request
+        # deadline, the harness applies it
+        options.deadline_s = injector.spec.deadline_s
     try:
-        results = runner.scan_paths(targets, _scan_options(args))
+        results = runner.scan_paths(targets, options)
         stats = runner.last_stats
     finally:
         runner.close()
     if getattr(args, "sched_stats", False):
-        print(json.dumps(stats.get("sched", stats), indent=2),
-              file=sys.stderr)
+        dump = stats.get("sched", stats)
+        if injector is not None:
+            dump = dict(dump)
+            dump["faults"] = injector.stats()
+        print(json.dumps(dump, indent=2), file=sys.stderr)
     return _finish_many(args, results)
 
 
@@ -1114,6 +1197,13 @@ def _finish_many(args, results) -> int:
                       file=sys.stderr)
                 code = max(code, 1)
                 continue
+            if getattr(res, "status", "ok") == "degraded":
+                # degraded slot: the report is complete and correct
+                # (host fallback) — annotate on stderr, keep exit 0
+                causes = "; ".join(
+                    f"{c.stage}/{c.kind}" for c in res.causes)
+                print(f"warning: {res.name}: degraded ({causes})",
+                      file=sys.stderr)
             report = res.report
             try:
                 report.results = filter_results(
@@ -1161,6 +1251,8 @@ def run_sbom(args) -> int:
     """Scan an SBOM file (ref pkg/commands/artifact/run.go sbomScanner:
     vulnerability checks only)."""
     from .artifact.sbom import SBOMArtifact
+    if _reject_unwired_fault_spec(args):
+        return 2
     if not os.path.isfile(args.target):
         print(f"error: no such file: {args.target}", file=sys.stderr)
         return 1
@@ -1196,6 +1288,8 @@ def run_sbom(args) -> int:
 def run_repo(args) -> int:
     """Scan a git repository (ref pkg/fanal/artifact/remote)."""
     from .artifact.remote import GitError, RemoteRepoArtifact
+    if _reject_unwired_fault_spec(args):
+        return 2
     cache = _cache(args)
     artifact = RemoteRepoArtifact(
         args.target, cache, option=_artifact_option(args),
@@ -1226,6 +1320,8 @@ def run_repo(args) -> int:
 
 
 def run_fs(args) -> int:
+    if _reject_unwired_fault_spec(args):
+        return 2
     if not os.path.isdir(args.target):
         print(f"error: no such directory: {args.target}",
               file=sys.stderr)

@@ -1,0 +1,145 @@
+"""Fault scenarios: what to break, when, deterministically.
+
+A :class:`FaultSpec` is the declarative half of the fault layer — a
+seeded description of which failure domains misbehave and how hard.
+Scenarios are the named presets the docs (docs/robustness.md), the
+``--fault-spec`` CLI flag, the bench ``faults`` config, and the
+pytest fixture all share, so "cache-outage" means the same thing in
+a unit test and in a bench run. Every stochastic decision draws from
+one seeded RNG: the same spec against the same workload injects the
+same faults.
+
+Spec strings::
+
+    cache-outage                       # a named scenario, defaults
+    cache-outage:seed=7,cache_fail_ops=80
+    poison-image:poison=img7.tar
+    poison=img3.tar;img9.tar,device_fail_batches=1   # bare overrides
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields, replace
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One deterministic fault scenario. Zero values mean "healthy"."""
+
+    scenario: str = ""
+    seed: int = 20260804
+
+    # -- cache backend (exercises the circuit breaker + FS/memory
+    #    fallback in artifact/resilient.py)
+    cache_fail_ops: int = 0     # first N cache ops raise; -1 = every op
+    cache_fail_rate: float = 0.0  # per-op failure probability
+
+    # -- device dispatch (exercises batch bisection + quarantine in
+    #    sched/scheduler.py)
+    device_fail_batches: int = 0  # first N dispatches raise (transient)
+    device_fail_rate: float = 0.0  # per-dispatch failure probability
+    device_stall_s: float = 0.0   # every dispatch sleeps this long
+    poison: tuple = ()   # request-name substrings that poison a batch
+
+    # -- host phases
+    corrupt: tuple = ()  # request-name substrings whose image load fails
+    stall_s: float = 0.0      # slow-host: analyze sleeps this long
+    stall_rate: float = 1.0   # fraction of analyzes stalled
+
+    # -- RPC surface (exercises idempotency keys + client retry)
+    rpc_error_first: int = 0   # first N POSTs answer 500 unprocessed
+    rpc_error_rate: float = 0.0
+    rpc_drop_first: int = 0    # first N POSTs process, then drop the
+    rpc_drop_rate: float = 0.0  # response (lost-response retry case)
+
+    # -- deadline storm: the harness applies this as the per-request
+    #    deadline (the spec only carries the number)
+    deadline_s: float = 0.0
+
+    def wants_cache_faults(self) -> bool:
+        return bool(self.cache_fail_ops or self.cache_fail_rate)
+
+    def wants_device_faults(self) -> bool:
+        return bool(self.device_fail_batches or self.device_fail_rate
+                    or self.device_stall_s or self.poison)
+
+    def wants_rpc_faults(self) -> bool:
+        return bool(self.rpc_error_first or self.rpc_error_rate
+                    or self.rpc_drop_first or self.rpc_drop_rate)
+
+
+# Named presets. ``standard-outage`` is the bench/acceptance scenario:
+# a cache outage long enough to trip the breaker and recover, one
+# poisoned image per 64 (callers name it via poison=...), and one
+# transient device error.
+SCENARIOS: dict = {
+    "cache-outage": {"cache_fail_ops": 40},
+    "cache-down": {"cache_fail_ops": -1},
+    "cache-flaky": {"cache_fail_rate": 0.2},
+    "device-transient": {"device_fail_batches": 2},
+    "device-persistent": {"device_fail_rate": 1.0},
+    "poison-image": {"poison": ("poison",)},
+    "corrupt-layer": {"corrupt": ("corrupt",)},
+    "rpc-flaky": {"rpc_drop_rate": 0.2, "rpc_error_rate": 0.2},
+    "rpc-lost-response": {"rpc_drop_first": 1},
+    "slow-host": {"stall_s": 0.2, "stall_rate": 0.25},
+    "deadline-storm": {"deadline_s": 0.05},
+    "standard-outage": {"cache_fail_ops": 40,
+                        "device_fail_batches": 1,
+                        "poison": ("poison",)},
+}
+
+_FIELDS = {f.name: f for f in fields(FaultSpec)}
+
+
+def _coerce(name: str, raw: str):
+    f = _FIELDS[name]
+    if f.type in ("tuple", tuple):
+        return tuple(p for p in raw.split(";") if p)
+    if f.type in ("int", int):
+        return int(raw)
+    if f.type in ("float", float):
+        return float(raw)
+    return raw
+
+
+def parse_fault_spec(text) -> FaultSpec:
+    """``"scenario[:k=v,...]"`` or bare ``"k=v,..."`` → FaultSpec.
+
+    Unknown scenario names and unknown keys raise ValueError so a
+    typo'd --fault-spec fails the run up front instead of silently
+    injecting nothing.
+    """
+    if isinstance(text, FaultSpec):
+        return text
+    text = (text or "").strip()
+    if not text:
+        return FaultSpec()
+    name, sep, rest = text.partition(":")
+    if not sep and "=" in name:
+        name, rest = "", text
+    overrides: dict = {}
+    if name:
+        preset = SCENARIOS.get(name)
+        if preset is None:
+            raise ValueError(
+                f"unknown fault scenario {name!r} "
+                f"(choose from {', '.join(sorted(SCENARIOS))})")
+        overrides.update(preset)
+        overrides["scenario"] = name
+    for pair in rest.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        key, eq, raw = pair.partition("=")
+        key = key.strip()
+        if not eq or key not in _FIELDS:
+            raise ValueError(
+                f"bad fault-spec entry {pair!r} "
+                f"(want key=value with a FaultSpec field)")
+        try:
+            overrides[key] = _coerce(key, raw.strip())
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad fault-spec value for {key!r}: {raw!r}")
+    return replace(FaultSpec(), **overrides)

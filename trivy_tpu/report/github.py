@@ -22,6 +22,9 @@ class GithubWriter:
         scanned = self.now or datetime.now(timezone.utc)\
             .strftime("%Y-%m-%dT%H:%M:%SZ")
         metadata = {}
+        status = getattr(report, "status", "")
+        if status and status != "ok":
+            metadata["aquasecurity:trivy:ScanStatus"] = status
         if report.metadata.repo_tags:
             metadata["aquasecurity:trivy:RepoTag"] = \
                 ", ".join(report.metadata.repo_tags)

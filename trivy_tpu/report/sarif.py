@@ -227,5 +227,14 @@ class SarifWriter:
                 },
             }],
         }
+        status = getattr(report, "status", "")
+        if status and status != "ok":
+            # degraded-mode annotation: run-level properties, so a
+            # partially-failed fleet scan is machine-detectable
+            doc["runs"][0]["properties"] = {
+                "scanStatus": status,
+                "failureCauses": [c.to_dict()
+                                  for c in report.failure_causes],
+            }
         json.dump(doc, self.output, indent=2)
         self.output.write("\n")

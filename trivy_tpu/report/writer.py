@@ -84,6 +84,15 @@ def render_table(report: Report,
     sevs = [str(s) if isinstance(s, Severity) else s
             for s in (severities or _SEV_ORDER)]
     lines = []
+    status = getattr(report, "status", "")
+    if status and status != "ok":
+        # degraded-mode banner (docs/robustness.md): the scan
+        # completed with survivable faults — say which, up front
+        lines.append("")
+        lines.append(f"!! scan {status.upper()}: "
+                     f"{report.artifact_name}")
+        for c in report.failure_causes:
+            lines.append(f"   - {c.stage}/{c.kind}: {c.message}")
     for result in report.results:
         header = result.target
         if result.vulnerabilities:

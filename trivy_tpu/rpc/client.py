@@ -125,8 +125,16 @@ class RemoteScanner(_Client):
     def scan(self, target, options) -> tuple:
         """``target`` is a ScanTarget — same call shape as
         LocalScanner.scan, so the CLI swaps drivers freely
-        (scanner.Driver in the reference)."""
+        (scanner.Driver in the reference).
+
+        Every Scan carries a fresh idempotency key shared by all
+        retry attempts of THIS call: if a response is lost after the
+        server enqueued the scan, the retry replays the first
+        enqueue's outcome instead of double-enqueuing into the
+        scheduler."""
+        import uuid
         out = self.call(SCANNER_PREFIX + "Scan", {
+            "idempotency_key": uuid.uuid4().hex,
             "target": target.name,
             "artifact_id": target.artifact_id,
             "blob_ids": list(target.blob_ids),

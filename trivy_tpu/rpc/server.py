@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..artifact.cache import FSCache, MemoryCache
 from ..db import AdvisoryStore, CompiledDB
+from ..sched import QueueFullError
 from ..db.compiled import SwappableStore
 from ..scan.local import LocalScanner, ScanTarget
 from ..types import ScanOptions
@@ -31,6 +32,74 @@ log = get_logger("rpc.server")
 SCANNER_PREFIX = "/twirp/trivy.scanner.v1.Scanner/"
 CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
 DEFAULT_TOKEN_HEADER = "Trivy-Token"
+IDEMPOTENCY_TTL_S = 300.0
+
+
+class ServerDraining(RuntimeError):
+    """New work refused: the server is shutting down (503)."""
+
+
+class _IdemEntry:
+    """One idempotent Scan in flight or completed: duplicate keys
+    wait on the event and replay the stored outcome."""
+
+    def __init__(self, ttl_s: float):
+        self.expires = time.monotonic() + ttl_s
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def resolve(self, result=None,
+                error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def outcome(self, timeout: float):
+        if not self._event.wait(timeout):
+            raise RuntimeError(
+                "idempotent request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _IdempotencyCache:
+    """Dedup window for RPC Scan: the client's 5xx retry loop can
+    resend a request whose response was lost AFTER the server
+    enqueued it — without this, every lost response double-enqueues
+    the scan into the scheduler."""
+
+    def __init__(self, ttl_s: float = IDEMPOTENCY_TTL_S):
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+        self.hits = 0
+
+    def claim(self, key: str) -> tuple:
+        """(fresh, entry): fresh means the caller runs the scan and
+        resolves the entry; otherwise it waits on the entry."""
+        now = time.monotonic()
+        with self._lock:
+            for k in [k for k, e in self._entries.items()
+                      if e.expires <= now]:
+                del self._entries[k]
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.hits += 1
+                return False, entry
+            entry = _IdemEntry(self.ttl_s)
+            self._entries[key] = entry
+            return True, entry
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "ttl_s": self.ttl_s}
 
 
 class ScanServer:
@@ -58,6 +127,11 @@ class ScanServer:
         self.cache = cache
         self.token = token
         self.token_header = token_header
+        self._idem = _IdempotencyCache()
+        self._draining = False
+        # fault_injector: trivy_tpu.faults.FaultInjector (or None);
+        # the HTTP handler consults it per POST (--fault-spec)
+        self.fault_injector = None
         self.scheduler = None
         self._owns_scheduler = False
         if hasattr(sched, "submit"):        # a ScanScheduler
@@ -75,6 +149,22 @@ class ScanServer:
         # externally provided one may serve other request sources
         if self.scheduler is not None and self._owns_scheduler:
             self.scheduler.close()
+
+    def begin_drain(self) -> None:
+        """New Scan RPCs answer 503 from here on; queued and
+        in-flight work keeps running until shutdown_gracefully."""
+        self._draining = True
+
+    def shutdown_gracefully(self, timeout_s: float = 30.0) -> bool:
+        """SIGTERM path: 503 new work, drain the admission queue,
+        flush in-flight batches, then close. True when everything
+        drained inside the timeout."""
+        self.begin_drain()
+        drained = True
+        if self.scheduler is not None:
+            drained = self.scheduler.drain(timeout_s)
+        self.close()
+        return drained
 
     # ---- Cache service (service.proto:10-15) ----
 
@@ -101,6 +191,35 @@ class ScanServer:
     # ---- Scanner service (service.proto:8-29) ----
 
     def scan(self, body: dict) -> dict:
+        """Scan entry: drain gate + idempotent replay around the
+        actual scan. A duplicate key within the TTL never reaches
+        the scheduler — the retry that follows a lost response waits
+        on (or replays) the first enqueue's outcome instead."""
+        if self._draining:
+            raise ServerDraining("server draining, retry elsewhere")
+        key = str(body.get("idempotency_key") or "")[:128]
+        if not key:
+            return self._scan(body)
+        fresh, entry = self._idem.claim(key)
+        if not fresh:
+            return entry.outcome(timeout=self._idem.ttl_s)
+        try:
+            out = self._scan(body)
+        except BaseException as e:
+            # only SUCCESS is worth replaying: the lost-response
+            # hazard this cache exists for applies to work that was
+            # enqueued and completed. Caching an error would make a
+            # transient server-side failure terminal for the whole
+            # retry loop (every retry reuses the key); forget the
+            # entry so the next attempt re-runs, and resolve any
+            # concurrent duplicate waiters with this outcome
+            self._idem.forget(key)
+            entry.resolve(error=e)
+            raise
+        entry.resolve(result=out)
+        return out
+
+    def _scan(self, body: dict) -> dict:
         opts = body.get("options") or {}
         options = ScanOptions(
             vuln_type=opts.get("vuln_type") or ["os", "library"],
@@ -166,10 +285,16 @@ class ScanServer:
         return req.result()
 
     def metrics(self) -> dict:
-        """The /metrics payload: scheduler state when serving is on."""
-        if self.scheduler is None:
-            return {"scheduler": "off"}
-        return self.scheduler.stats()
+        """The /metrics payload: scheduler state when serving is on,
+        plus the cache circuit breaker and idempotency window."""
+        out = {"scheduler": "off"} if self.scheduler is None \
+            else self.scheduler.stats()
+        out["draining"] = self._draining
+        out["idempotency"] = self._idem.stats()
+        breaker = getattr(self.cache, "breaker_stats", None)
+        if callable(breaker):
+            out["cache_breaker"] = breaker()
+        return out
 
     # ---- dispatch ----
 
@@ -256,6 +381,15 @@ def _make_handler(server: ScanServer):
             if self.path == "/healthz":
                 self._reply(200, {"status": "ok"})
             elif self.path == "/metrics":
+                # /healthz stays open (probes), but the operational
+                # detail in /metrics honors the server token
+                if server.token:
+                    import hmac
+                    got = self.headers.get(server.token_header) or ""
+                    if not hmac.compare_digest(got, server.token):
+                        self._reply(401, {"code": "unauthenticated",
+                                          "msg": "invalid token"})
+                        return
                 self._reply(200, server.metrics())
             else:
                 self._reply(404, {"code": "bad_route",
@@ -269,6 +403,15 @@ def _make_handler(server: ScanServer):
                     self._reply(401, {"code": "unauthenticated",
                                       "msg": "invalid token"})
                     return
+            inj = server.fault_injector
+            action = inj.rpc_action(self.path) if inj is not None \
+                else "ok"
+            if action == "error":
+                # injected transport fault BEFORE processing — the
+                # client's 5xx retry covers it
+                self._reply(500, {"code": "injected",
+                                  "msg": "injected rpc error"})
+                return
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b"{}"
             try:
@@ -277,7 +420,7 @@ def _make_handler(server: ScanServer):
                 self._reply(400, {"code": "malformed",
                                   "msg": "invalid json body"})
                 return
-            from ..sched import DeadlineExceeded, QueueFullError
+            from ..sched import DeadlineExceeded, SchedulerClosed
             try:
                 out = server.handle(self.path, body)
             except LookupError:
@@ -290,6 +433,12 @@ def _make_handler(server: ScanServer):
                 self._reply(503, {"code": "resource_exhausted",
                                   "msg": str(e)})
                 return
+            except (ServerDraining, SchedulerClosed) as e:
+                # graceful shutdown: also transient from the fleet's
+                # perspective — another replica will take the retry
+                self._reply(503, {"code": "unavailable",
+                                  "msg": str(e)})
+                return
             except DeadlineExceeded as e:
                 # the request's own deadline — retrying would expire
                 # again, so answer with a non-retried 4xx
@@ -300,6 +449,12 @@ def _make_handler(server: ScanServer):
                 log.warning("rpc %s failed: %r", self.path, e)
                 self._reply(500, {"code": "internal",
                                   "msg": str(e)})
+                return
+            if action == "drop":
+                # injected lost response AFTER processing: the work
+                # happened, the client never hears back — exactly the
+                # case Scan idempotency keys exist for
+                self.close_connection = True
                 return
             self._reply(200, out)
 
@@ -328,16 +483,35 @@ def serve(addr: str = "127.0.0.1", port: int = 4954,
 
 def serve_forever(addr: str, port: int, server: ScanServer,
                   db_watch_prefix: str = "",
-                  db_watch_interval_s: float = 60.0) -> None:
+                  db_watch_interval_s: float = 60.0,
+                  drain_timeout_s: float = 30.0) -> None:
+    """Foreground serve with graceful SIGTERM handling: on signal,
+    new Scan RPCs answer 503 while queued and in-flight requests run
+    to completion (bounded by ``drain_timeout_s``), then the process
+    exits — a rolling restart never drops accepted work."""
+    import signal
+
     httpd, worker = serve(addr, port, server, db_watch_prefix,
                           db_watch_interval_s)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        log.info("signal %s: draining", signum)
+        stop.set()
+
     try:
-        while True:
-            time.sleep(3600)
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass                    # not the main thread (tests)
+    try:
+        while not stop.wait(1.0):
+            pass
     except KeyboardInterrupt:
         pass
     finally:
         if worker:
             worker.stop()
-        server.close()
+        # order matters: 503 new work first, drain while the HTTP
+        # server still delivers in-flight responses, THEN stop it
+        server.shutdown_gracefully(drain_timeout_s)
         httpd.shutdown()

@@ -22,7 +22,7 @@ SURVEY.md §2.6).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..artifact.artifact import ArtifactOption, ImageArtifact
@@ -42,13 +42,40 @@ class BatchScanResult:
     name: str
     report: Optional[Report] = None
     error: str = ""
+    # degraded-mode status (docs/robustness.md): ok | degraded |
+    # failed, with machine-readable FailureCause records. A slot
+    # with ``error`` set is failed; a slot that completed through a
+    # fault (device quarantine → host fallback) is degraded.
+    status: str = "ok"
+    causes: list = field(default_factory=list)
+
+    def apply_degraded(self, causes: list) -> None:
+        from ..types.report import FailureCause
+        fc = [FailureCause.coerce(c) for c in causes]
+        self.causes.extend(fc)
+        if self.status != "failed":
+            self.status = "degraded"
+        if self.report is not None:
+            self.report.mark_degraded(fc)
+
+    def mark_failed(self, stage: str, kind: str,
+                    message: str) -> "BatchScanResult":
+        from ..types.report import FailureCause
+        self.status = "failed"
+        self.causes.append(FailureCause(stage=stage, kind=kind,
+                                        message=message))
+        if self.report is not None:
+            self.report.mark_degraded(self.causes[-1:],
+                                      status="failed")
+        return self
 
 
 class BatchScanRunner:
     def __init__(self, store: Optional[AdvisoryStore] = None,
                  cache=None, backend: str = "tpu", mesh=None,
                  secret_scanner=None, sched="off",
-                 sched_config=None, artifact_option=None):
+                 sched_config=None, artifact_option=None,
+                 fault_injector=None):
         self.store = store or AdvisoryStore()
         self.cache = cache if cache is not None else MemoryCache()
         self.backend = backend
@@ -60,6 +87,10 @@ class BatchScanRunner:
                 mesh=mesh)
         self.secret_scanner = secret_scanner
         self.artifact_option = artifact_option
+        # fault_injector: trivy_tpu.faults.FaultInjector (or None) —
+        # threads into the scheduler's device dispatch and this
+        # runner's host phases (--fault-spec / bench faults config)
+        self.fault_injector = fault_injector
         # sched: "off" = the direct single-batch ladder below;
         # "on"/SchedConfig/ScanScheduler = continuous batching with
         # pipelined host/device overlap (trivy_tpu.sched)
@@ -87,6 +118,7 @@ class BatchScanRunner:
             self._scheduler = ScanScheduler(
                 config=self.sched_config, backend=self.backend,
                 mesh=self.mesh, secret_scanner=self.secret_scanner)
+            self._scheduler.fault_injector = self.fault_injector
             self._owns_scheduler = True
         return self._scheduler
 
@@ -107,9 +139,11 @@ class BatchScanRunner:
         images, failures = [], {}
         for i, p in enumerate(paths):
             try:
+                if self.fault_injector is not None:
+                    self.fault_injector.on_image_load(p)
                 images.append((i, load_image(p)))
             except (OSError, ValueError) as e:
-                failures[i] = BatchScanResult(name=p, error=str(e))
+                failures[i] = _failed_slot(p, e)
         results = self.scan_images([img for _, img in images],
                                    options)
         out = dict(failures)
@@ -150,7 +184,6 @@ class BatchScanRunner:
         scheduler and gathers results in input order; per-request
         failures (load errors, deadline expiry) fail their own slot,
         never the fleet."""
-        from ..sched import SchedError
         options = options or ScanOptions(backend=self.backend)
         sched = self.scheduler
         reqs = []
@@ -162,8 +195,10 @@ class BatchScanRunner:
         for (name, _), req in zip(items, reqs):
             try:
                 out.append(req.result())
-            except (SchedError, OSError, ValueError) as e:
-                out.append(BatchScanResult(name=name, error=str(e)))
+            except Exception as e:       # noqa: BLE001 — one slot's
+                # failure (typed or not) must never crash the fleet
+                # gather; the cause lands in the slot's report
+                out.append(_failed_slot(name, e))
         self.last_stats = {"images": len(items),
                            "sched": sched.stats()}
         for k, v in self.last_stats["sched"].items():
@@ -189,6 +224,12 @@ class BatchScanRunner:
         scan_secrets = "secret" in options.security_checks
 
         def analyze(req):
+            inj = self.fault_injector
+            if inj is not None:
+                # host failure domains: corrupt layer tar fails this
+                # slot only; a slow-host stall eats into the deadline
+                inj.on_host_analyze(name)
+                inj.on_image_load(name)
             img = image if image is not None else load_image(name)
             opt = self._image_opt(scan_secrets)
             a = _SchedImageArtifact(img, self.cache, opt)
@@ -379,7 +420,6 @@ class BatchScanRunner:
     def _scan_boms_scheduled(self, boms: list,
                              options: Optional[ScanOptions] = None)\
             -> list:
-        from ..sched import SchedError
         options = options or ScanOptions(
             backend=self.backend, security_checks=["vuln"])
         sched = self.scheduler
@@ -390,8 +430,8 @@ class BatchScanRunner:
         for (name, _), req in zip(boms, reqs):
             try:
                 out.append(req.result())
-            except (SchedError, ValueError) as e:
-                out.append(BatchScanResult(name=name, error=str(e)))
+            except Exception as e:       # noqa: BLE001
+                out.append(_failed_slot(name, e))
         self.last_stats = {"sboms": len(boms),
                            "sched": sched.stats()}
         return out
@@ -446,7 +486,7 @@ class BatchScanRunner:
             except ValueError as e:
                 # a malformed document fails its own slot, never the
                 # fleet (decode_to_blob normalizes decode crashes)
-                failures[i] = BatchScanResult(name=name, error=str(e))
+                failures[i] = _failed_slot(name, e)
                 continue
             self.cache.put_blob(blob_id, blob)
             prepared.append((i, scanner.prepare(
@@ -530,6 +570,27 @@ class _SchedImageArtifact(_CollectingImageArtifact):
                           for li, _, _ in candidates})
             self._sched.register_blob_writes(ids, self._sched_req)
         return super()._batch_secrets(candidates)
+
+
+def _failed_slot(name: str, err: BaseException) -> BatchScanResult:
+    """One failed fleet slot with a machine-readable cause: the
+    typed scheduler errors map to distinct kinds so a caller can
+    tell backpressure (retryable) from deadline (not) from a broken
+    image."""
+    from ..sched import (DeadlineExceeded, QueueFullError,
+                         SchedulerClosed)
+    if isinstance(err, DeadlineExceeded):
+        stage, kind = "sched", "deadline_exceeded"
+    elif isinstance(err, QueueFullError):
+        stage, kind = "sched", "queue_full"
+    elif isinstance(err, SchedulerClosed):
+        stage, kind = "sched", "shutdown"
+    elif isinstance(err, (OSError, ValueError)):
+        stage, kind = "host", "load_failed"
+    else:
+        stage, kind = "sched", "error"
+    return BatchScanResult(name=name, error=str(err)).mark_failed(
+        stage, kind, str(err))
 
 
 def _make_patch(cache, artifact):

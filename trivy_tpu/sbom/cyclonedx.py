@@ -275,6 +275,14 @@ class Marshaler:
             "dependencies": dependencies,
             "vulnerabilities": vulns,
         }
+        status = getattr(report, "status", "")
+        if status and status != "ok":
+            # degraded-mode annotation (docs/robustness.md); only
+            # emitted on faulted scans so fault-free BOMs keep golden
+            # parity
+            bom["metadata"]["properties"] = [
+                {"name": "aquasecurity:trivy:ScanStatus",
+                 "value": status}]
         return bom
 
     def marshal_vulnerabilities(self, report: Report) -> dict:

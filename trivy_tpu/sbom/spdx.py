@@ -249,6 +249,15 @@ class Marshaler:
         created = self.timestamp or datetime.now(timezone.utc)\
             .strftime("%Y-%m-%dT%H:%M:%SZ")
         packages.sort(key=lambda p: p["SPDXID"])
+        creation_info = {
+            "creators": ["Organization: aquasecurity",
+                         "Tool: trivy"],
+            "created": created,
+        }
+        status = getattr(report, "status", "")
+        if status and status != "ok":
+            # degraded-mode annotation; omitted on fault-free scans
+            creation_info["comment"] = f"scan status: {status}"
         return {
             "SPDXID": DOC_ID,
             "spdxVersion": SPDX_VERSION,
@@ -257,11 +266,7 @@ class Marshaler:
             "documentNamespace": (
                 f"{DOC_NAMESPACE}/{report.artifact_type}/"
                 f"{report.artifact_name}-{self.uuid_fn()}"),
-            "creationInfo": {
-                "creators": ["Organization: aquasecurity",
-                             "Tool: trivy"],
-                "created": created,
-            },
+            "creationInfo": creation_info,
             "packages": packages,
             "relationships": relationships,
         }

@@ -49,6 +49,10 @@ class SchedConfig:
     byte_buckets: tuple = (64 << 10, 256 << 10, 1 << 20, 4 << 20)
     job_buckets: tuple = (512, 2048, 8192, 32768)
     default_deadline_s: float = 0.0  # 0 = no deadline
+    # poison-image isolation: when a single-request dispatch fails,
+    # retry it this many times on-device before quarantining it to
+    # the exact host path (docs/robustness.md)
+    quarantine_retries: int = 1
     # flush as soon as the pipeline upstream drains (right for
     # closed-loop fleet scans: no more work is coming). Serving
     # deployments set False so ``flush_timeout_s`` acts as a real

@@ -115,6 +115,14 @@ class SchedMetrics:
         with self._lock:
             self.hist[phase].observe(seconds)
 
+    def in_flight(self) -> int:
+        """Admitted but unresolved requests (drain watches this)."""
+        with self._lock:
+            c = self.counters
+            resolved = (c["completed"] + c["failed"] +
+                        c["timed_out"] + c["cancelled"])
+            return max(0, c["submitted"] - resolved)
+
     def set_depth_gauge(self, fn) -> None:
         self._depth_fn = fn
 

@@ -85,6 +85,12 @@ class ScanRequest:
                          if deadline_s and deadline_s > 0 else None)
         self.on_done = on_done
         self.work: Optional[AnalyzedWork] = None
+        # faults: failure-domain events survived on this request's
+        # behalf (device quarantine, host fallback). Non-empty at
+        # completion → the result is annotated status=degraded with
+        # these as machine-readable causes. Written only by the
+        # device executor thread.
+        self.faults: list = []
         # patched_event: set once this request's secret patch landed
         # in the cache — other requests sharing a layer blob wait on
         # it before their final secret merge
@@ -119,6 +125,11 @@ class ScanRequest:
 
     def set_error(self, error: BaseException) -> bool:
         return self._resolve(error=error)
+
+    def record_fault(self, stage: str, kind: str,
+                     message: str) -> None:
+        self.faults.append({"stage": stage, "kind": kind,
+                            "message": message})
 
     def cancel(self) -> None:
         """Best-effort: marks the request; a stage that has not yet

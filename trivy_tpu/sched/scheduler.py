@@ -46,6 +46,20 @@ from .queue import (AdmissionQueue, DeadlineExceeded, QueueFullError,
 log = get_logger("sched")
 
 
+def _annotate_degraded(result, faults: list):
+    """Thread the request's survived faults into whatever shape the
+    finish callable produced: objects expose ``apply_degraded``
+    (BatchScanResult), RPC responses are plain dicts, anything else
+    passes through unannotated (the caller still got a result)."""
+    mark = getattr(result, "apply_degraded", None)
+    if mark is not None:
+        mark(faults)
+    elif isinstance(result, dict):
+        result["status"] = "degraded"
+        result["failure_causes"] = [dict(f) for f in faults]
+    return result
+
+
 class ScanScheduler:
     """Owns the queue, the coalescer, the worker pool, and the
     device executor. One instance per process serves every request
@@ -58,6 +72,10 @@ class ScanScheduler:
         self.backend = backend
         self.mesh = mesh
         self.secret_scanner = secret_scanner
+        # fault_injector: optional trivy_tpu.faults.FaultInjector —
+        # consulted at the top of every device dispatch so injected
+        # device failures exercise the bisect/quarantine machinery
+        self.fault_injector = None
         self.metrics = SchedMetrics()
         self.queue = AdmissionQueue(self.config.max_queue)
         self.metrics.set_depth_gauge(self.queue.depth)
@@ -68,6 +86,7 @@ class ScanScheduler:
         self._analyzing = 0
         self._kernel_s = 0.0      # interval-kernel wall (all batches)
         self._running = False
+        self._draining = False
         self._lock = threading.Lock()
         # blob id → patch event of the request that will write it
         self._blob_lock = threading.Lock()
@@ -125,6 +144,28 @@ class ScanScheduler:
             t.join(timeout=5 if wait else 0)
         self._threads = []
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: refuse new admissions (submit raises
+        SchedulerClosed, which the RPC layer answers 503), let the
+        queued and in-flight requests run to completion, then close.
+        Returns True when everything drained inside the timeout."""
+        with self._lock:
+            if not self._running:
+                return True
+            self._draining = True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            if self.metrics.in_flight() == 0 \
+                    and self.queue.depth() == 0 \
+                    and self.coalescer.pending() == 0:
+                with self._cv:
+                    if self._analyzing == 0:
+                        break
+            time.sleep(0.02)
+        drained = self.metrics.in_flight() == 0
+        self.close()
+        return drained
+
     def __enter__(self) -> "ScanScheduler":
         return self.start()
 
@@ -137,6 +178,8 @@ class ScanScheduler:
                block: bool = False) -> ScanRequest:
         """Admit one request. Raises QueueFullError (backpressure)
         unless ``block``, SchedulerClosed after close()."""
+        if self._draining:
+            raise SchedulerClosed("scheduler draining")
         if not self._running:
             self.start()
         if request.deadline is None and \
@@ -166,6 +209,7 @@ class ScanScheduler:
             "max_batch_items": self.config.max_batch_items,
         }
         out["backend"] = self.backend
+        out["draining"] = self._draining
         with self._lock:
             out["interval_kernel_s"] = round(self._kernel_s, 4)
         return out
@@ -310,14 +354,55 @@ class ScanScheduler:
             self._fail(req, SchedulerClosed("scheduler closed"))
 
     def _execute(self, batch: Batch) -> None:
-        from ..detect.batch import dispatch_jobs
-
         reqs = [r for r in batch.requests if not self._sweep(r)]
         if not reqs:
             return
         self.metrics.note_batch(
             len(reqs), batch.candidate_bytes, batch.jobs,
             batch.bucket_bytes, batch.bucket_jobs)
+
+        results = self._dispatch_isolated(reqs,
+                                          batch.group or self.backend)
+
+        # patch + event-set happen HERE, on the device thread, so
+        # every patch event is resolved without touching the worker
+        # pool — a finish waiting on another request's patch can
+        # never starve the work that would satisfy it
+        for r in reqs:
+            out = results.get(id(r))
+            if out is None:
+                continue             # quarantine already failed it
+            if self._sweep(r):
+                # the deadline passed while the batch ran on device:
+                # the collect is abandoned (sweep resolved it 408)
+                self.metrics.inc("expired_inflight")
+                continue
+            found, detected = out
+            try:
+                if r.work.patch is not None:
+                    r.work.patch(found)
+            except Exception as e:   # noqa: BLE001
+                log.warning("patch %r failed: %r", r.name, e)
+                self._fail(r, e)
+                continue
+            r.patched_event.set()
+            self._clear_blob_writes(r)
+            try:
+                self._pool.submit(self._finish, r, found, detected)
+            except RuntimeError:     # pool shut down under us
+                self._fail(r, SchedulerClosed("scheduler closed"))
+
+    # --- poison-image isolation (docs/robustness.md) ---
+
+    def _dispatch(self, reqs: list, group: str) -> dict:
+        """One coalesced device dispatch over ``reqs`` →
+        ``{id(req): (sieve_found, detected)}``. Raises on device
+        failure — isolation happens in _dispatch_isolated."""
+        from ..detect.batch import dispatch_jobs
+
+        if self.fault_injector is not None:
+            self.fault_injector.on_device_dispatch(
+                [r.name for r in reqs])
 
         # flatten sieve candidates; owner map brings results home by
         # ENTRY INDEX (paths repeat across images — see secret.batch)
@@ -328,6 +413,16 @@ class ScanScheduler:
                 owner.append(i)
                 local.append(j)
 
+        # payloads are tagged with the request's batch index for the
+        # duration of the dispatch and restored after — a bisect
+        # retry re-tags against ITS OWN indices, so a failed dispatch
+        # must never leave its wrapping behind
+        wrapped = []
+        for i, r in enumerate(reqs):
+            for job in r.work.jobs:
+                wrapped.append((job, job.payload))
+                job.payload = (i, job.payload)
+
         t0 = self.metrics.device_begin()
         try:
             sieve_handle = None
@@ -337,16 +432,12 @@ class ScanScheduler:
                 sieve_handle = self.secret_scanner.dispatch_files(
                     files)
 
-            all_jobs = []
-            for i, r in enumerate(reqs):
-                for job in r.work.jobs:
-                    job.payload = (i, job.payload)
-                    all_jobs.append(job)
+            all_jobs = [job for job, _ in wrapped]
             detected_by: dict = {}
             if all_jobs:
                 kstats: dict = {}    # per-batch sink, not the global
                 for i, payload in dispatch_jobs(
-                        all_jobs, backend=batch.group or self.backend,
+                        all_jobs, backend=group,
                         mesh=self.mesh, stats=kstats):
                     detected_by.setdefault(i, []).append(payload)
                 with self._lock:
@@ -359,29 +450,87 @@ class ScanScheduler:
                     found_by.setdefault(owner[idx], []).append(
                         (local[idx], secret))
         finally:
+            for job, orig in wrapped:
+                job.payload = orig
             self.metrics.device_end(t0)
         self.metrics.observe("device", time.monotonic() - t0)
+        return {id(r): (found_by.get(i, []), detected_by.get(i, []))
+                for i, r in enumerate(reqs)}
 
-        # patch + event-set happen HERE, on the device thread, so
-        # every patch event is resolved without touching the worker
-        # pool — a finish waiting on another request's patch can
-        # never starve the work that would satisfy it
-        for i, r in enumerate(reqs):
-            found = found_by.get(i, [])
+    def _dispatch_isolated(self, reqs: list, group: str) -> dict:
+        """Dispatch with failure isolation: a raising batch is
+        bisected until the poison request(s) are cornered alone,
+        retried bounded, then quarantined to the exact host path —
+        the rest of the batch completes normally. Only a request
+        whose host fallback ALSO fails resolves with an error."""
+        try:
+            return self._dispatch(reqs, group)
+        except Exception as e:       # noqa: BLE001
+            if len(reqs) == 1:
+                return self._quarantine(reqs[0], group, e)
+            log.warning("device dispatch failed for %d requests "
+                        "(%r); bisecting", len(reqs), e)
+            self.metrics.inc("batch_bisects")
+            mid = (len(reqs) + 1) // 2
+            out = self._dispatch_isolated(reqs[:mid], group)
+            out.update(self._dispatch_isolated(reqs[mid:], group))
+            return out
+
+    def _quarantine(self, req: ScanRequest, group: str,
+                    err: BaseException) -> dict:
+        """Single failing request: bounded on-device retries (a
+        transient may clear), then the host-fallback path."""
+        for _ in range(max(0, self.config.quarantine_retries)):
             try:
-                if r.work.patch is not None:
-                    r.work.patch(found)
+                return self._dispatch([req], group)
             except Exception as e:   # noqa: BLE001
-                log.warning("patch %r failed: %r", r.name, e)
-                self._fail(r, e)
-                continue
-            r.patched_event.set()
-            self._clear_blob_writes(r)
+                err = e
+        self.metrics.inc("quarantined")
+        log.warning("quarantining %r after device failure: %r",
+                    req.name, err)
+        req.record_fault(
+            "device", "quarantined",
+            f"device dispatch failed, completed on host: {err}")
+        try:
+            out = self._host_fallback(req)
+            self.metrics.inc("host_fallbacks")
+            return out
+        except Exception as e2:      # noqa: BLE001
+            log.warning("host fallback for %r failed: %r",
+                        req.name, e2)
+            req.record_fault("host", "fallback_failed", str(e2))
+            self._fail(req, e2)
+            return {}
+
+    def _host_fallback(self, req: ScanRequest) -> dict:
+        """The exact host path for one quarantined request: a
+        whole-file CPU secret scan (reference engine — identical
+        findings to the sieve by construction) and the cpu-ref
+        interval evaluation (detect/batch.py host fallback)."""
+        from ..detect.batch import dispatch_jobs
+
+        work = req.work
+        found = []
+        base = getattr(self.secret_scanner, "scanner", None)
+        if work.candidates and base is not None:
+            for j, (path, content) in enumerate(work.candidates):
+                secret = base.scan(path, content)
+                if secret.findings:
+                    found.append((j, secret))
+        detected = []
+        if work.jobs:
+            wrapped = [(job, job.payload) for job in work.jobs]
+            for job, orig in wrapped:
+                job.payload = (0, orig)
             try:
-                self._pool.submit(self._finish, r, found,
-                                  detected_by.get(i, []))
-            except RuntimeError:     # pool shut down under us
-                self._fail(r, SchedulerClosed("scheduler closed"))
+                for _i, payload in dispatch_jobs(
+                        work.jobs, backend="cpu-ref", mesh=None,
+                        stats={}):
+                    detected.append(payload)
+            finally:
+                for job, orig in wrapped:
+                    job.payload = orig
+        return {id(req): (found, detected)}
 
     # --- stage 3: host finish ---
 
@@ -401,7 +550,14 @@ class ScanScheduler:
                         return
                     if self._sweep(req):
                         return
+            if self._sweep(req):
+                # expired after the device batch resolved but before
+                # assembly — abandon, the 408 already went out
+                self.metrics.inc("expired_inflight")
+                return
             result = work.finish(found, detected)
+            if req.faults:
+                result = _annotate_degraded(result, req.faults)
             self._complete(req, result)
         except Exception as e:       # noqa: BLE001
             log.warning("finish %r failed: %r", req.name, e)

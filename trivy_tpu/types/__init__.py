@@ -48,6 +48,10 @@ from .report import (
     Metadata,
     Report,
     ScanOptions,
+    FailureCause,
+    STATUS_OK,
+    STATUS_DEGRADED,
+    STATUS_FAILED,
 )
 
 __all__ = [
@@ -60,4 +64,5 @@ __all__ = [
     "DetectedVulnerability", "Vulnerability", "CauseMetadata", "MisconfResult",
     "Misconfiguration", "MisconfSummary", "DetectedMisconfiguration",
     "DetectedLicense", "Result", "Metadata", "Report", "ScanOptions",
+    "FailureCause", "STATUS_OK", "STATUS_DEGRADED", "STATUS_FAILED",
 ]

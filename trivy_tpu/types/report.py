@@ -223,6 +223,34 @@ class Result:
         return bool(self.licenses)
 
 
+# --- degraded-mode scan status (docs/robustness.md) ---
+
+STATUS_OK = "ok"
+STATUS_DEGRADED = "degraded"
+STATUS_FAILED = "failed"
+
+
+@dataclass
+class FailureCause:
+    """Machine-readable cause attached to a degraded/failed target:
+    which failure domain broke (stage), how it was handled (kind),
+    and the underlying error text."""
+
+    stage: str = jfield("Stage", default="")    # cache|host|device|rpc|sched
+    kind: str = jfield("Kind", default="")      # quarantined|circuit_open|...
+    message: str = jfield("Message", default="")
+
+    def to_dict(self) -> dict:
+        return asdict_omitempty(self)
+
+    @classmethod
+    def coerce(cls, c) -> "FailureCause":
+        if isinstance(c, cls):
+            return c
+        return cls(stage=c.get("stage", ""), kind=c.get("kind", ""),
+                   message=c.get("message", ""))
+
+
 # Go's encoding/json cannot omit an empty struct: Metadata.ImageConfig
 # (a v1.ConfigFile value) always serializes, as this zero value for
 # non-image scans (see any fs golden, e.g. integration/testdata/
@@ -261,6 +289,11 @@ class Report:
     metadata: Metadata = jfield("Metadata", default_factory=Metadata,
                                 keep=True)
     results: list = jfield("Results", default_factory=list)
+    # degraded-mode annotations: "" means ok and is omitted from the
+    # JSON, so fault-free reports stay byte-identical to the goldens
+    status: str = jfield("Status", default="")
+    failure_causes: list = jfield("FailureCauses",
+                                  default_factory=list)
     # original CycloneDX header kept for SBOM rescans — never
     # serialized (ref pkg/types Report.CycloneDX `json:"-"`)
     cyclonedx: Optional[dict] = field(default=None)
@@ -269,6 +302,15 @@ class Report:
         d = asdict_omitempty(self)
         d.pop("cyclonedx", None)
         return d
+
+    def mark_degraded(self, causes,
+                      status: str = STATUS_DEGRADED) -> None:
+        """Attach failure causes; failed never downgrades back to
+        degraded."""
+        if self.status != STATUS_FAILED:
+            self.status = status
+        self.failure_causes.extend(
+            FailureCause.coerce(c) for c in causes)
 
 
 @dataclass
