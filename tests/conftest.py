@@ -33,6 +33,22 @@ def mesh8():
 
 
 @pytest.fixture
+def hostile_corpus(tmp_path):
+    """Materialize the adversarial ingest corpus
+    (trivy_tpu/faults/hostile.py) at a test-friendly scale:
+    ``hostile_corpus()`` → ([(builder name, image path)], limits)
+    where ``limits`` are the matching scaled ResourceLimits."""
+    from trivy_tpu.faults.hostile import build_corpus, hostile_limits
+
+    def make(scale: float = 0.05, only=None, seed: int = 20260804):
+        corpus = build_corpus(str(tmp_path / "hostile"), seed=seed,
+                              only=only, scale=scale)
+        return corpus, hostile_limits(scale)
+
+    return make
+
+
+@pytest.fixture
 def make_faults():
     """Build a deterministic FaultInjector from a --fault-spec
     string, e.g. ``make_faults("poison-image:poison=img3.tar")``

@@ -110,9 +110,11 @@ class TestResolveChain:
             "etc/alpine-release": b"3.9.4\n"}])
 
         class FakeRegistry(RegistryClient):
-            def pull(self, ref):
+            # the seam contract carries the ingest budget since the
+            # hostile-artifact hardening (docs/robustness.md)
+            def pull(self, ref, budget=None):
                 assert ref == "registry.example/alpine:3.9"
-                return load_image(img, name=ref)
+                return load_image(img, name=ref, budget=budget)
 
         src = resolve_image("registry.example/alpine:3.9",
                             daemon=DaemonClient(sockets=()),

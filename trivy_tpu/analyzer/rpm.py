@@ -62,7 +62,16 @@ class RpmDBAnalyzer(Analyzer):
         from ..rpmdb import list_packages
         try:
             rpkgs = list_packages(content)
-        except ValueError:
+        except ValueError as e:
+            # a corrupt rpmdb is survivable hostile input: the scan
+            # completes without rpm packages, but the slot reports
+            # status=degraded with an ingest-stage cause instead of
+            # silently pretending the image has no rpm database
+            from ..guard.budget import current_budget
+            b = current_budget.get()
+            if b is not None:
+                b.note("malformed-archive",
+                       f"corrupt rpmdb at {path}: {e}")
             return None
         pkgs = []
         system_files = []

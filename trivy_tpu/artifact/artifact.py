@@ -42,6 +42,13 @@ class ArtifactOption:
     scan_secrets: bool = True
     scan_misconfig: bool = False       # IaC config collection
     scan_licenses: bool = False        # license classification
+    # ingest guards (trivy_tpu/guard, docs/robustness.md): ON by
+    # default with DEFAULT_LIMITS; --no-ingest-guards turns them off
+    # (the differential baseline). ``ingest_limits`` overrides the
+    # limits; the per-target ResourceBudget itself is created fresh
+    # per scan (never shared across targets).
+    ingest_guards: bool = True
+    ingest_limits: object = None       # ResourceLimits or None
 
 
 def _secret_scanner(opt: ArtifactOption):
@@ -66,10 +73,30 @@ def _effective_disabled(opt: ArtifactOption) -> list:
 
 class ImageArtifact:
     def __init__(self, image: ImageSource, cache,
-                 option: Optional[ArtifactOption] = None):
+                 option: Optional[ArtifactOption] = None,
+                 budget=None):
         self.image = image
         self.cache = cache
         self.opt = option or ArtifactOption()
+        # one ResourceBudget per target: prefer an explicit one, then
+        # the budget the image was loaded under (so layer reads and
+        # the walk charge the SAME counters), else a fresh one when
+        # guards are on
+        if budget is None:
+            budget = getattr(image, "ingest_budget", None)
+        if budget is None and self.opt.ingest_guards:
+            from ..guard.budget import make_budget
+            budget = make_budget(self.opt.ingest_limits,
+                                 name=getattr(image, "name", ""))
+        self.budget = budget
+        image.ingest_budget = budget
+        arch = getattr(image, "archive", None)
+        if arch is not None and budget is not None and \
+                arch.budget is None:
+            # the image was loaded unguarded: retrofit the budget
+            # onto the shared archive handle so layer blob reads and
+            # gzip decompression charge it too
+            arch.budget = budget
         self.group = AnalyzerGroup(
             disabled=_effective_disabled(self.opt),
             file_patterns=self.opt.file_patterns)
@@ -80,6 +107,11 @@ class ImageArtifact:
         opts_key = {"skip_dirs": self.opt.skip_dirs,
                     "skip_files": self.opt.skip_files,
                     "patterns": sorted(self.opt.file_patterns),
+                    # guards change which entries of a HOSTILE layer
+                    # survive the walk, so guarded and unguarded
+                    # blobs must never share cache keys (clean
+                    # layers produce identical content either way)
+                    "ingest_guards": self.budget is not None,
                     "secrets": self.opt.scan_secrets,
                     "misconfig": self.opt.scan_misconfig,
                     "licenses": self.opt.scan_licenses,
@@ -164,13 +196,36 @@ class ImageArtifact:
         # "secrets" belong to the base image's publisher, not this
         # image (ref image.go:215-218); `base` also marked these
         # layers' cache keys in inspect()
+        import contextlib
         layer_results = []
         all_candidates = []        # (layer_idx, path, content)
+        budget = self.budget
+        ctx = budget.activate() if budget is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            self._analyze_layers(todo, layer_results, all_candidates,
+                                 base)
+        if budget is not None:
+            budget.flush_metrics()
+
+        secrets_by_layer = self._batch_secrets(all_candidates)
+
+        for i, result, opq_dirs, wh_files in layer_results:
+            result.secrets = secrets_by_layer.get(i, [])
+            blob = result.to_blob_info(diff_id=self.image.diff_ids[i])
+            blob.opaque_dirs = opq_dirs
+            blob.whiteout_files = wh_files
+            post_handle(blob)
+            self.cache.put_blob(blob_ids[i], blob)
+
+    def _analyze_layers(self, todo: list, layer_results: list,
+                        all_candidates: list, base: set) -> None:
         for i in todo:
             layer = self.image.layers[i]
             result = AnalysisResult()
             with layer.open() as tf:
-                files, opq_dirs, wh_files = collect_layer_tar(tf)
+                files, opq_dirs, wh_files = collect_layer_tar(
+                    tf, budget=self.budget)
                 for path, size, read in files:
                     if self._skipped(path):
                         continue
@@ -184,16 +239,6 @@ class ImageArtifact:
                 continue
             for path, content in result.secret_candidates:
                 all_candidates.append((i, path, content))
-
-        secrets_by_layer = self._batch_secrets(all_candidates)
-
-        for i, result, opq_dirs, wh_files in layer_results:
-            result.secrets = secrets_by_layer.get(i, [])
-            blob = result.to_blob_info(diff_id=self.image.diff_ids[i])
-            blob.opaque_dirs = opq_dirs
-            blob.whiteout_files = wh_files
-            post_handle(blob)
-            self.cache.put_blob(blob_ids[i], blob)
 
     def _batch_secrets(self, candidates: list) -> dict:
         """ONE kernel dispatch across every missing layer's files.

@@ -12,6 +12,16 @@ member index is parsed once (tarfile re-scans all headers per open,
 which dominated fleet-scan host time when each layer re-opened the
 outer tar). ``ImageSource.close()`` releases the handle; the image
 artifact closes it as soon as layer analysis is done.
+
+Hostile-input posture (docs/robustness.md): ``load_image`` takes an
+optional per-scan :class:`ResourceBudget`. With one, manifest/config
+reads are capped (an oversize image config trips), layer blobs are
+size-checked before materializing, gzip layers stream through the
+bounded decompressor (a bomb trips the byte budget or the ratio
+tripwire), and structural tar errors surface as the typed
+:class:`MalformedArchiveError` instead of raw tarfile exceptions.
+The budget rides on the returned ``ImageSource`` so the artifact
+layer keeps charging the same counters while walking layers.
 """
 
 from __future__ import annotations
@@ -24,6 +34,10 @@ import os
 import tarfile
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+from ..guard.budget import (MalformedArchiveError, ResourceBudget,
+                            ResourceBudgetExceeded)
+from ..guard.safetar import open_layer_bytes
 
 
 @dataclass
@@ -41,6 +55,10 @@ class ImageSource:
     repo_tags: list = field(default_factory=list)
     repo_digests: list = field(default_factory=list)
     archive: Optional["_Archive"] = None
+    # the per-scan ingest budget the image was loaded under (None =
+    # guards off); the artifact layer picks it up so layer walking
+    # charges the same counters
+    ingest_budget: Optional[ResourceBudget] = None
 
     @property
     def diff_ids(self) -> list:
@@ -59,23 +77,69 @@ class _Archive:
     member index once, re-open transparently if read after
     close()."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str,
+                 budget: Optional[ResourceBudget] = None):
         self.path = path
+        self.budget = budget
         self._tf: Optional[tarfile.TarFile] = None
 
     def tf(self) -> tarfile.TarFile:
         if self._tf is None:
-            self._tf = tarfile.open(self.path)
+            try:
+                self._tf = tarfile.open(self.path)
+            except tarfile.TarError as e:
+                if self.budget is not None:
+                    self.budget.malformed(
+                        f"unreadable image archive: {e}")
+                raise
         return self._tf
 
     def names(self) -> list:
-        return self.tf().getnames()
+        try:
+            return self.tf().getnames()
+        except tarfile.TarError as e:
+            if self.budget is not None:
+                self.budget.malformed(
+                    f"unreadable image archive: {e}")
+            raise
 
-    def read(self, member: str) -> bytes:
-        f = self.tf().extractfile(member)
-        if f is None:
+    def read(self, member: str,
+             limit: Optional[int] = None) -> bytes:
+        """Read one outer-tar member; with a budget, the member size
+        is checked against ``limit`` (metadata reads) or the
+        remaining decompressed-byte budget (layer blobs) BEFORE
+        materializing."""
+        budget = self.budget
+        try:
+            info = self.tf().getmember(member)
+        except KeyError:
             raise ValueError(f"missing member {member}")
-        return f.read()
+        except tarfile.TarError as e:
+            if budget is not None:
+                budget.malformed(f"unreadable image archive: {e}")
+            raise
+        if budget is not None:
+            budget.check_deadline()
+            if info.size < 0:
+                budget.malformed(
+                    f"negative size for member {member!r}")
+            if limit is not None and info.size > limit:
+                raise ResourceBudgetExceeded(
+                    f"image metadata member {member!r} exceeds "
+                    f"{limit} bytes ({info.size})")
+            if limit is None and \
+                    info.size > budget.remaining_bytes():
+                budget.exceeded(
+                    f"layer blob {member!r} exceeds the remaining "
+                    f"decompressed-byte budget ({info.size})")
+        try:
+            f = self.tf().extractfile(member)
+            if f is None:
+                raise ValueError(f"missing member {member}")
+            return f.read()
+        except tarfile.TarError as e:
+            raise MalformedArchiveError(
+                f"truncated image archive at {member!r}: {e}") from e
 
     def close(self) -> None:
         if self._tf is not None:
@@ -83,30 +147,76 @@ class _Archive:
             self._tf = None
 
 
-def load_image(path: str, name: Optional[str] = None) -> ImageSource:
+def _meta_limit(budget: Optional[ResourceBudget]) -> Optional[int]:
+    return budget.limits.max_config_bytes if budget is not None \
+        else None
+
+
+def _parse_json(data: bytes, what: str,
+                budget: Optional[ResourceBudget]) -> dict:
+    try:
+        return json.loads(data)
+    except ValueError as e:
+        if budget is not None:
+            budget.malformed(f"invalid {what} JSON: {e}")
+        raise
+
+
+def load_image(path: str, name: Optional[str] = None,
+               budget: Optional[ResourceBudget] = None)\
+        -> ImageSource:
     """Sniff + load a docker-save tar / OCI layout tar / OCI dir."""
     name = name or path
     if os.path.isdir(path):
-        return _load_oci_dir(path, name)
-    arch = _Archive(path)
+        try:
+            src = _load_oci_dir(path, name, budget)
+        except (KeyError, IndexError, TypeError) as e:
+            if budget is not None:
+                budget.malformed(f"malformed image metadata: {e!r}")
+            raise ValueError(
+                f"malformed image metadata: {e!r}") from e
+        src.ingest_budget = budget
+        return src
+    arch = _Archive(path, budget=budget)
     try:
-        names = arch.names()
-        if "manifest.json" in names:
-            return _load_docker_save(arch, name)
-        if "index.json" in names:
-            return _load_oci_tar(arch, name)
+        try:
+            names = arch.names()
+            if "manifest.json" in names:
+                src = _load_docker_save(arch, name)
+            elif "index.json" in names:
+                src = _load_oci_tar(arch, name)
+            else:
+                raise ValueError(
+                    f"unrecognized image archive: {path}")
+        except (KeyError, IndexError, TypeError) as e:
+            # crafted manifests/configs with missing or mistyped
+            # fields must fail as a typed load error, never a raw
+            # KeyError escaping the artifact boundary
+            if budget is not None:
+                budget.malformed(f"malformed image metadata: {e!r}")
+            raise ValueError(
+                f"malformed image metadata: {e!r}") from e
     except Exception:
         arch.close()
         raise
-    arch.close()
-    raise ValueError(f"unrecognized image archive: {path}")
+    src.ingest_budget = budget
+    return src
 
 
 # --- docker save format ---
 
 def _load_docker_save(arch: _Archive, name: str) -> ImageSource:
-    manifest = json.loads(arch.read("manifest.json"))[0]
-    config = json.loads(arch.read(manifest["Config"]))
+    budget = arch.budget
+    lim = _meta_limit(budget)
+    doc = _parse_json(arch.read("manifest.json", limit=lim),
+                      "manifest", budget)
+    if not isinstance(doc, list) or not doc:
+        if budget is not None:
+            budget.malformed("empty or non-list manifest.json")
+        raise ValueError("empty or non-list manifest.json")
+    manifest = doc[0]
+    config = _parse_json(arch.read(manifest["Config"], limit=lim),
+                         "image config", budget)
     diff_ids = config.get("rootfs", {}).get("diff_ids", [])
     layer_paths = manifest.get("Layers", [])
     layers = [
@@ -125,39 +235,65 @@ def _load_docker_save(arch: _Archive, name: str) -> ImageSource:
 # --- OCI layout ---
 
 def _load_oci_tar(arch: _Archive, name: str) -> ImageSource:
-    index = json.loads(arch.read("index.json"))
-    src = _load_oci(index, arch.read, name,
-                    opener=lambda p: _member_layer_opener(arch, p))
+    budget = arch.budget
+    lim = _meta_limit(budget)
+    index = _parse_json(arch.read("index.json", limit=lim),
+                        "OCI index", budget)
+    src = _load_oci(index, lambda m: arch.read(m, limit=lim), name,
+                    opener=lambda p: _member_layer_opener(arch, p),
+                    budget=budget)
     src.archive = arch
     return src
 
 
-def _load_oci_dir(path: str, name: str) -> ImageSource:
-    with open(os.path.join(path, "index.json")) as f:
-        index = json.load(f)
+def _load_oci_dir(path: str, name: str,
+                  budget: Optional[ResourceBudget] = None)\
+        -> ImageSource:
+    lim = _meta_limit(budget)
 
     def read(rel: str) -> bytes:
-        with open(os.path.join(path, rel), "rb") as f:
+        full = os.path.join(path, rel)
+        if budget is not None:
+            budget.check_deadline()
+            size = os.path.getsize(full)
+            if lim is not None and size > lim:
+                raise ResourceBudgetExceeded(
+                    f"image metadata blob {rel!r} exceeds "
+                    f"{lim} bytes ({size})")
+        with open(full, "rb") as f:
             return f.read()
 
-    def opener(rel: str) -> Callable:
-        return lambda: _open_layer_file(os.path.join(path, rel))
+    with open(os.path.join(path, "index.json"), "rb") as f:
+        raw = f.read(lim + 1 if lim is not None else -1)
+    if lim is not None and len(raw) > lim:
+        raise ResourceBudgetExceeded(
+            f"OCI index exceeds {lim} bytes")
+    index = _parse_json(raw, "OCI index", budget)
 
-    return _load_oci(index, read, name, opener)
+    def opener(rel: str) -> Callable:
+        return lambda: _open_layer_file(os.path.join(path, rel),
+                                        budget)
+
+    return _load_oci(index, read, name, opener, budget=budget)
 
 
 def _load_oci(index: dict, read: Callable, name: str,
-              opener: Callable) -> ImageSource:
+              opener: Callable,
+              budget: Optional[ResourceBudget] = None)\
+        -> ImageSource:
     manifests = index.get("manifests", [])
     if not manifests:
         raise ValueError("empty OCI index")
     mdigest = manifests[0]["digest"]
-    manifest = json.loads(read(_blob_path(mdigest)))
+    manifest = _parse_json(read(_blob_path(mdigest)), "OCI manifest",
+                           budget)
     if manifest.get("manifests"):        # nested index (multi-arch)
         mdigest = manifest["manifests"][0]["digest"]
-        manifest = json.loads(read(_blob_path(mdigest)))
+        manifest = _parse_json(read(_blob_path(mdigest)),
+                               "OCI manifest", budget)
     cdigest = manifest["config"]["digest"]
-    config = json.loads(read(_blob_path(cdigest)))
+    config = _parse_json(read(_blob_path(cdigest)), "image config",
+                         budget)
     diff_ids = config.get("rootfs", {}).get("diff_ids", [])
     layers = []
     for d, desc in zip(diff_ids, manifest.get("layers", [])):
@@ -168,7 +304,11 @@ def _load_oci(index: dict, read: Callable, name: str,
 
 
 def _blob_path(digest: str) -> str:
-    algo, _, hex_ = digest.partition(":")
+    # a digest names a blob FILE — validate before it becomes a
+    # path, or a crafted manifest ("sha256:../../../etc/secret")
+    # reads arbitrary host files into the report
+    from ..guard.safetar import validate_digest
+    algo, _, hex_ = validate_digest(digest).partition(":")
     return f"blobs/{algo}/{hex_}"
 
 
@@ -182,15 +322,28 @@ def _canon_json(obj) -> bytes:
 def _member_layer_opener(arch: _Archive, member: str) -> Callable:
     def open_layer() -> tarfile.TarFile:
         data = arch.read(member)
+        if arch.budget is not None:
+            return open_layer_bytes(data, arch.budget)
         if data[:2] == b"\x1f\x8b":
             data = gzip.decompress(data)
         return tarfile.open(fileobj=io.BytesIO(data))
     return open_layer
 
 
-def _open_layer_file(full: str) -> tarfile.TarFile:
+def _open_layer_file(full: str,
+                     budget: Optional[ResourceBudget] = None)\
+        -> tarfile.TarFile:
+    if budget is not None:
+        budget.check_deadline()
+        size = os.path.getsize(full)
+        if size > budget.remaining_bytes():
+            budget.exceeded(
+                f"layer blob {full!r} exceeds the remaining "
+                f"decompressed-byte budget ({size})")
     with open(full, "rb") as f:
         data = f.read()
+    if budget is not None:
+        return open_layer_bytes(data, budget)
     if data[:2] == b"\x1f\x8b":
         data = gzip.decompress(data)
     return tarfile.open(fileobj=io.BytesIO(data))

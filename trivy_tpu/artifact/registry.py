@@ -23,11 +23,14 @@ from __future__ import annotations
 import atexit
 import base64
 import hashlib
+import http.client
 import json
 import os
+import random
 import shutil
 import ssl
 import tempfile
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -94,23 +97,42 @@ def _is_loopback(registry: str) -> bool:
     return host in ("localhost", "::1") or host.startswith("127.")
 
 
+# transient statuses retried with exponential backoff + jitter
+# (go-containerregistry's retry transport does the same set);
+# every other 4xx is authoritative and fails fast
+RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
+
+
 class DistributionClient:
     """Plugs into resolve_image's registry seam
-    (artifact/resolve.py RegistryClient interface)."""
+    (artifact/resolve.py RegistryClient interface).
+
+    Both HTTP legs — the token handshake and manifest/blob GETs —
+    run behind bounded retries: up to ``retries`` extra attempts on
+    429/5xx/URLError with exponential backoff and full jitter,
+    honoring ``Retry-After`` when the registry sends one. A flaky
+    registry or throttling edge therefore costs latency, not the
+    scan; a 404/401 still fails on the first answer."""
 
     def __init__(self, platform: str = "linux/amd64",
                  insecure: bool = False,
                  auth: Optional[tuple] = None,
-                 registry_token: str = ""):
+                 registry_token: str = "",
+                 retries: int = 3,
+                 backoff_s: float = 0.2,
+                 backoff_max_s: float = 5.0):
         self.platform = platform
         self.insecure = insecure
         self.auth = auth                    # (user, password) or None
         self.registry_token = registry_token
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
         self._bearer: dict = {}             # registry → token
 
     # ---- transport ----
 
-    def _open(self, url: str, headers: dict) -> tuple:
+    def _open_once(self, url: str, headers: dict) -> tuple:
         req = urllib.request.Request(url, headers=headers)
         ctx = None
         if url.startswith("https:") and self.insecure:
@@ -121,8 +143,52 @@ class DistributionClient:
             return resp.status, dict(resp.headers), resp.read()
         except urllib.error.HTTPError as e:
             return e.code, dict(e.headers), e.read()
-        except (urllib.error.URLError, OSError) as e:
-            raise RegistryError(f"registry unreachable: {e}")
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
+            # HTTPException covers IncompleteRead — a server closing
+            # mid-body is a connection failure, not an HTTP answer
+            raise RegistryError(f"registry unreachable: {e!r}")
+
+    def _backoff(self, attempt: int, hdrs: Optional[dict]) -> None:
+        delay = None
+        retry_after = ""
+        for k, v in (hdrs or {}).items():
+            if k.lower() == "retry-after":
+                retry_after = v
+                break
+        if retry_after:
+            try:
+                delay = min(float(retry_after), self.backoff_max_s)
+            except ValueError:
+                pass                # HTTP-date form: fall through
+        if delay is None:
+            # full jitter on an exponential base — a retrying fleet
+            # must not re-synchronize onto the throttled registry
+            delay = min(self.backoff_max_s,
+                        self.backoff_s * (2 ** attempt))
+            delay *= random.random()
+        time.sleep(delay)
+
+    def _open(self, url: str, headers: dict) -> tuple:
+        for attempt in range(self.retries + 1):
+            try:
+                status, hdrs, body = self._open_once(url, headers)
+            except RegistryError:
+                # connection-level failure (URLError): transient
+                # until the retry budget says otherwise
+                if attempt >= self.retries:
+                    raise
+                self._backoff(attempt, None)
+                continue
+            if status in RETRYABLE_STATUSES and \
+                    attempt < self.retries:
+                log.debug("retrying %s after HTTP %d "
+                          "(attempt %d/%d)", url, status,
+                          attempt + 1, self.retries)
+                self._backoff(attempt, hdrs)
+                continue
+            return status, hdrs, body
+        raise RegistryError(f"retries exhausted for {url}")
 
     def _base(self, registry: str) -> str:
         scheme = "http" if _is_loopback(registry) else "https"
@@ -192,7 +258,16 @@ class DistributionClient:
     def _stream_blob(self, registry: str, repo: str, digest: str,
                      blob_dir: str, chunk: int = 1 << 20) -> None:
         """GET a blob streaming straight into the layout's blob
-        store, verifying the digest incrementally."""
+        store, verifying the digest incrementally. Transient
+        failures (429/5xx/connection drops mid-stream) retry the
+        whole GET with backoff — the file is rewritten from offset
+        zero each attempt, so a torn stream can never leave a
+        partial blob behind."""
+        from ..guard.safetar import validate_digest
+        # the digest comes from a (possibly malicious) registry's
+        # manifest and names the output FILE — validate before it
+        # touches the filesystem or the URL
+        validate_digest(digest)
         url = self._base(registry) + f"/v2/{repo}/blobs/{digest}"
         headers = self._auth_headers(registry,
                                      "application/octet-stream")
@@ -201,26 +276,38 @@ class DistributionClient:
             ctx = ssl._create_unverified_context()
         want_hex = digest.partition(":")[2]
         out_path = os.path.join(blob_dir, want_hex)
-        try:
-            req = urllib.request.Request(url, headers=headers)
-            with urllib.request.urlopen(req, timeout=30,
-                                        context=ctx) as resp, \
-                    open(out_path, "wb") as out:
-                h = hashlib.sha256()
-                while True:
-                    data = resp.read(chunk)
-                    if not data:
-                        break
-                    h.update(data)
-                    out.write(data)
-            if h.hexdigest() != want_hex:
+        for attempt in range(self.retries + 1):
+            try:
+                req = urllib.request.Request(url, headers=headers)
+                with urllib.request.urlopen(req, timeout=30,
+                                            context=ctx) as resp, \
+                        open(out_path, "wb") as out:
+                    h = hashlib.sha256()
+                    while True:
+                        data = resp.read(chunk)
+                        if not data:
+                            break
+                        h.update(data)
+                        out.write(data)
+                if h.hexdigest() != want_hex:
+                    raise RegistryError(
+                        f"blob {digest} digest mismatch")
+                return
+            except urllib.error.HTTPError as e:
+                if e.code in RETRYABLE_STATUSES and \
+                        attempt < self.retries:
+                    self._backoff(attempt, dict(e.headers))
+                    continue
                 raise RegistryError(
-                    f"blob {digest} digest mismatch")
-        except urllib.error.HTTPError as e:
-            raise RegistryError(
-                f"GET blob {digest}: HTTP {e.code}")
-        except (urllib.error.URLError, OSError) as e:
-            raise RegistryError(f"registry unreachable: {e}")
+                    f"GET blob {digest}: HTTP {e.code}")
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                # IncompleteRead (a dropped stream mid-body) lands
+                # here — retried like any other connection failure
+                if attempt < self.retries:
+                    self._backoff(attempt, None)
+                    continue
+                raise RegistryError(f"registry unreachable: {e!r}")
 
     # ---- pull ----
 
@@ -253,7 +340,7 @@ class DistributionClient:
                 f"manifest digest mismatch: want {reference}, "
                 f"got sha256:{got}")
 
-    def pull(self, ref: str) -> ImageSource:
+    def pull(self, ref: str, budget=None) -> ImageSource:
         registry, repo, reference = parse_ref(ref)
         hdrs, body = self._get(
             registry, f"/v2/{repo}/manifests/{reference}")
@@ -302,7 +389,7 @@ class DistributionClient:
                 "digest": manifest_digest, "size": len(body),
             }]}, f)
 
-        src = load_image(layout, name=ref)
+        src = load_image(layout, name=ref, budget=budget)
         # repo metadata like the reference's remote image
         # (remote.go:87-98): tags only for tag references — a
         # digest-pinned pull reports no RepoTags — and RepoDigests

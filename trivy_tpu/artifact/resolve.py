@@ -174,10 +174,10 @@ class RegistryClient:
         from .registry import DistributionClient
         self._client = DistributionClient(**kwargs)
 
-    def pull(self, ref: str) -> ImageSource:
+    def pull(self, ref: str, budget=None) -> ImageSource:
         from .registry import RegistryError
         try:
-            return self._client.pull(ref)
+            return self._client.pull(ref, budget=budget)
         except (RegistryError, KeyError, ValueError, OSError) as e:
             # KeyError/ValueError: malformed or schema-1 manifests
             # (no 'config' key, non-JSON body); OSError: temp layout
@@ -187,13 +187,13 @@ class RegistryClient:
                 f"directory)")
 
 
-def _loaded_tmp(tmp: str, ref: str, name: Optional[str])\
-        -> ImageSource:
+def _loaded_tmp(tmp: str, ref: str, name: Optional[str],
+                budget=None) -> ImageSource:
     """Load an exported archive whose layers are read lazily during
     the scan — the file must outlive this call. The scan driver
     calls src.cleanup() when done; atexit is the backstop for
     library users who forget."""
-    src = load_image(tmp, name=name or ref)
+    src = load_image(tmp, name=name or ref, budget=budget)
     src.cleanup = lambda: (os.path.exists(tmp) and os.unlink(tmp))
     atexit.register(src.cleanup)
     return src
@@ -202,13 +202,16 @@ def _loaded_tmp(tmp: str, ref: str, name: Optional[str])\
 def resolve_image(ref: str, name: Optional[str] = None,
                   daemon: Optional[DaemonClient] = None,
                   containerd: Optional[ContainerdClient] = None,
-                  registry: Optional[RegistryClient] = None)\
-        -> ImageSource:
+                  registry: Optional[RegistryClient] = None,
+                  budget=None) -> ImageSource:
     """image.go:66-105's fallback chain: tryDockerd → tryPodman →
-    tryContainerd → tryRemote."""
+    tryContainerd → tryRemote. ``budget`` (a guard ResourceBudget)
+    rides every leg — a registry pull is the MOST untrusted input
+    this tool handles, so the bomb/traversal guards must hold there
+    exactly as on --input archives."""
     # 1. local archive / layout
     if os.path.exists(ref):
-        return load_image(ref, name=name)
+        return load_image(ref, name=name, budget=budget)
 
     # 2. daemon export (docker + podman sockets)
     daemon = daemon or DaemonClient()
@@ -220,7 +223,7 @@ def resolve_image(ref: str, name: Optional[str] = None,
             leg_errs.append(f"daemon: {e}")
             log.warning("daemon resolution failed: %s", e)
         else:
-            return _loaded_tmp(tmp, ref, name)
+            return _loaded_tmp(tmp, ref, name, budget)
 
     # 3. containerd export
     containerd = containerd or ContainerdClient()
@@ -231,12 +234,12 @@ def resolve_image(ref: str, name: Optional[str] = None,
             leg_errs.append(f"containerd: {e}")
             log.warning("containerd resolution failed: %s", e)
         else:
-            return _loaded_tmp(tmp, ref, name)
+            return _loaded_tmp(tmp, ref, name, budget)
 
     # 4. registry pull
     registry = registry or RegistryClient()
     try:
-        return registry.pull(ref)
+        return registry.pull(ref, budget=budget)
     except ResolveError as e:
         if leg_errs:
             raise ResolveError(f"{e} ({'; '.join(leg_errs)})")

@@ -153,9 +153,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/robustness.md): a scenario name "
                         "(cache-outage, poison-image, "
                         "device-transient, rpc-flaky, slow-host, "
-                        "standard-outage ...) optionally followed "
-                        "by :key=value overrides, e.g. "
+                        "standard-outage, hostile-ingest ...) "
+                        "optionally followed by :key=value "
+                        "overrides, e.g. "
                         "poison-image:poison=img7.tar")
+        sp.add_argument("--max-decompressed-bytes", type=int,
+                        default=0,
+                        help="ingest guard: per-target decompressed-"
+                        "byte budget (default 1 GiB; "
+                        "docs/robustness.md)")
+        sp.add_argument("--max-files", type=int, default=0,
+                        help="ingest guard: per-target archive "
+                        "entry budget (default 100000)")
+        sp.add_argument("--ingest-deadline-s", type=float,
+                        default=0.0,
+                        help="ingest guard: per-target wall-clock "
+                        "deadline for image load + layer walking "
+                        "(default 300s)")
+        sp.add_argument("--no-ingest-guards", action="store_true",
+                        help="disable the ingest resource budgets "
+                        "and safe-tar checks (differential "
+                        "baseline; scanning untrusted artifacts "
+                        "without guards is unsafe)")
         sp.add_argument("--config", "-c", default="",
                         help="config file (default: trivy.yaml)")
         sp.add_argument("--server", default="",
@@ -861,7 +880,28 @@ def _artifact_option(args) -> ArtifactOption:
         scan_secrets="secret" in checks,
         scan_misconfig="config" in checks,
         scan_licenses="license" in checks,
+        ingest_guards=not getattr(args, "no_ingest_guards", False),
+        ingest_limits=_ingest_limits(args),
     )
+
+
+def _ingest_limits(args):
+    """--max-decompressed-bytes/--max-files/--ingest-deadline-s →
+    ResourceLimits (None = pure defaults; zero values keep each
+    default)."""
+    from .guard import DEFAULT_LIMITS
+    import dataclasses
+    overrides = {}
+    if getattr(args, "max_decompressed_bytes", 0):
+        overrides["max_decompressed_bytes"] = \
+            args.max_decompressed_bytes
+    if getattr(args, "max_files", 0):
+        overrides["max_files"] = args.max_files
+    if getattr(args, "ingest_deadline_s", 0.0):
+        overrides["ingest_deadline_s"] = args.ingest_deadline_s
+    if not overrides:
+        return None
+    return dataclasses.replace(DEFAULT_LIMITS, **overrides)
 
 
 def _file_patterns(pairs) -> dict:
@@ -1046,22 +1086,28 @@ def run_image(args) -> int:
         print("error: image target or --input required",
               file=sys.stderr)
         return 2
+    opt = _artifact_option(args)
+    from .guard import make_budget
+    budget = make_budget(opt.ingest_limits,
+                         enabled=opt.ingest_guards, name=path)
     try:
         if args.input:
             # an explicit archive path must fail as a file error,
             # never fall through to daemon/registry resolution
             image = load_image(args.input,
-                               name=args.target or args.input)
+                               name=args.target or args.input,
+                               budget=budget)
         else:
             from .artifact.resolve import resolve_image
-            image = resolve_image(path, name=args.target or path)
+            image = resolve_image(path, name=args.target or path,
+                                  budget=budget)
     except (OSError, ValueError, tarfile_error) as e:
         print(f"error: failed to load image {path!r}: {e}",
               file=sys.stderr)
         return 1
     cache = _cache(args)
-    artifact = ImageArtifact(image, cache,
-                             option=_artifact_option(args))
+    artifact = ImageArtifact(image, cache, option=opt,
+                             budget=budget)
     try:
         ref = artifact.inspect()
         scanner = _scanner(args, cache)
@@ -1088,6 +1134,16 @@ def run_image(args) -> int:
         ),
         results=results,
     )
+    budget = getattr(artifact, "budget", None)
+    if budget is not None and budget.soft_faults:
+        # survivable hostile input (docs/robustness.md): report the
+        # scan degraded with ingest-stage causes, keep exit 0
+        report.mark_degraded(
+            [{"stage": "ingest", "kind": k, "message": m}
+             for k, m in budget.soft_faults])
+        for k, m in budget.soft_faults:
+            print(f"warning: {ref.name}: degraded (ingest/{k}): {m}",
+                  file=sys.stderr)
     return _finish(args, report)
 
 
@@ -1147,6 +1203,21 @@ def _run_image_batch(args, targets: list) -> int:
     cache = _cache(args)
     if injector is not None:
         cache = injector.wrap_cache(cache)
+    hostile_dir = ""
+    if injector is not None and injector.spec.hostile:
+        # hostile-ingest drill (docs/robustness.md): materialize the
+        # seeded adversarial corpus and append it to the fleet — the
+        # guard layer must quarantine each hostile slot per-target
+        # while the listed targets complete untouched
+        import tempfile
+        from .faults.hostile import build_corpus
+        hostile_dir = tempfile.mkdtemp(prefix="trivy-tpu-hostile-")
+        extra = build_corpus(hostile_dir, seed=injector.spec.seed,
+                             only=list(injector.spec.hostile))
+        targets = list(targets) + [p for _, p in extra]
+        print(f"fault-spec: added {len(extra)} hostile artifacts "
+              f"to the fleet (seed={injector.spec.seed})",
+              file=sys.stderr)
     runner = BatchScanRunner(
         store=store, cache=cache, backend=backend,
         secret_scanner=opt.secret_scanner,
@@ -1164,6 +1235,9 @@ def _run_image_batch(args, targets: list) -> int:
         stats = runner.last_stats
     finally:
         runner.close()
+        if hostile_dir:
+            import shutil
+            shutil.rmtree(hostile_dir, ignore_errors=True)
     if getattr(args, "sched_stats", False):
         dump = stats.get("sched", stats)
         if injector is not None:

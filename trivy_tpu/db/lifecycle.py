@@ -167,7 +167,10 @@ def _read_json(path: str) -> dict:
 
 
 def _blob_path(layout_dir: str, digest: str) -> str:
-    algo, _, hexd = digest.partition(":")
+    # validate BEFORE the digest becomes a filesystem path — a
+    # crafted index/manifest must not read outside the layout
+    from ..guard.safetar import validate_digest
+    algo, _, hexd = validate_digest(digest).partition(":")
     return os.path.join(layout_dir, "blobs", algo, hexd)
 
 
@@ -175,7 +178,10 @@ def read_oci_layout(layout_dir: str) -> tuple:
     """OCI image layout → (layer bytes, title annotation).
 
     Mirrors pkg/oci/artifact.go:46-103: exactly one layer, media type
-    must be the trivy-db tgz, title annotation must be present."""
+    must be the trivy-db tgz, title annotation must be present — and
+    the layer bytes must hash to the digest the manifest pins (a
+    tampered or torn download fails HERE, before any unpack)."""
+    import hashlib
     index = _read_json(os.path.join(layout_dir, "index.json"))
     manifests = index.get("manifests") or []
     if not manifests:
@@ -192,41 +198,79 @@ def read_oci_layout(layout_dir: str) -> tuple:
     title = (layer.get("annotations") or {}).get(TITLE_ANNOTATION)
     if not title:
         raise ValueError(f"annotation {TITLE_ANNOTATION} is missing")
-    with open(_blob_path(layout_dir, layer["digest"]), "rb") as f:
-        return f.read(), title
+    digest = layer.get("digest") or ""
+    with open(_blob_path(layout_dir, digest), "rb") as f:
+        blob = f.read()
+    algo, _, want = digest.partition(":")
+    if algo != "sha256":
+        raise ValueError(f"unsupported layer digest {digest!r}")
+    got = hashlib.sha256(blob).hexdigest()
+    if got != want:
+        raise ValueError(
+            f"layer digest mismatch: manifest pins {digest}, "
+            f"blob is sha256:{got}")
+    return blob, title
 
 
 def update_from_oci_layout(
         layout_dir: str, cache_dir: str,
         now: Optional[datetime.datetime] = None) -> Metadata:
     """``trivy-tpu db update --from-oci-layout``: unpack the layer
-    tgz into <cache>/db/ and stamp DownloadedAt (db.go Download:
-    146-184). Returns the resulting metadata."""
+    tgz and install it ATOMICALLY into <cache>/db/ (db.go Download:
+    146-184 + hostile-input hardening, docs/robustness.md):
+
+    1. unpack into a temp dir NEXT TO the destination (same fs, so
+       the final ``os.replace`` is atomic), through the bounded
+       safe-tar reader (a bomb or 100k-entry flood trips the budget
+       instead of filling the disk);
+    2. verify the unpacked ``trivy.db`` opens as a valid BoltDB
+       (meta-page magic + checksum);
+    3. only then drop the stale metadata/compiled tables and
+       ``os.replace`` the new files in.
+
+    A corrupt, truncated, or tampered download therefore raises and
+    leaves the PREVIOUS DB serving — never a half-written install.
+    Returns the resulting metadata."""
+    import shutil
+    import tempfile
+
+    from ..guard.budget import ResourceBudget, ResourceLimits
+    from ..guard.safetar import safe_extract_db_archive
+
     now = now or datetime.datetime.now(datetime.timezone.utc)
     blob, _title = read_oci_layout(layout_dir)
     dest = db_dir(cache_dir)
     os.makedirs(dest, exist_ok=True)
-    # delete stale metadata first like the reference (db.go:148-151),
-    # and any compiled tables derived from the OLD trivy.db — they
-    # would silently shadow the fresh install in _store otherwise
-    for stale in (metadata_path(cache_dir),
-                  os.path.join(dest, "compiled.npz")):
-        try:
-            os.remove(stale)
-        except OSError:
-            pass
-    raw = gzip.decompress(blob)
-    with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
-        for member in tf.getmembers():
-            name = os.path.basename(member.name)
-            if name not in ("trivy.db", "metadata.json") or \
-                    not member.isfile():
-                continue
-            src = tf.extractfile(member)
-            with open(os.path.join(dest, name), "wb") as out:
-                out.write(src.read())
-    if not os.path.exists(os.path.join(dest, "trivy.db")):
-        raise ValueError("OCI layer does not contain trivy.db")
+
+    budget = ResourceBudget(
+        ResourceLimits(max_decompressed_bytes=4 << 30,
+                       max_file_bytes=4 << 30, max_files=64,
+                       ingest_deadline_s=600.0),
+        name="db-update")
+    tmpdir = tempfile.mkdtemp(prefix=".db-install-", dir=cache_dir)
+    try:
+        safe_extract_db_archive(blob, tmpdir, budget)
+        bolt_tmp = os.path.join(tmpdir, "trivy.db")
+        if not os.path.exists(bolt_tmp):
+            raise ValueError("OCI layer does not contain trivy.db")
+        from .boltdb import BoltDB
+        BoltDB(bolt_tmp).close()     # CorruptDB (a ValueError) if not
+        # validation passed — point of no return: drop the stale
+        # metadata (db.go:148-151) and any compiled tables derived
+        # from the OLD trivy.db (they would silently shadow the
+        # fresh install in _store), then swap the new files in
+        for stale in (metadata_path(cache_dir),
+                      os.path.join(dest, "compiled.npz")):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        os.replace(bolt_tmp, os.path.join(dest, "trivy.db"))
+        meta_tmp = os.path.join(tmpdir, "metadata.json")
+        if os.path.exists(meta_tmp):
+            os.replace(meta_tmp, metadata_path(cache_dir))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
     meta = load_metadata(cache_dir) or Metadata(
         version=SCHEMA_VERSION)
     meta.downloaded_at = now

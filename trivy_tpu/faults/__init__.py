@@ -11,12 +11,16 @@ drain — under reproducible failure, from pytest (``-m faults``), the
 CLI (``--fault-spec``), and the bench (``faults`` config).
 """
 
+from .hostile import (BUILDERS as HOSTILE_BUILDERS, EXPECTED_STATUS,
+                      build_corpus, corrupt_boltdb_layout,
+                      hostile_limits)
 from .inject import (CacheFault, CorruptLayerFault, DeviceFault,
                      FaultInjector, FaultyCache, InjectedFault)
 from .spec import SCENARIOS, FaultSpec, parse_fault_spec
 
 __all__ = [
     "CacheFault", "CorruptLayerFault", "DeviceFault", "FaultInjector",
-    "FaultSpec", "FaultyCache", "InjectedFault", "SCENARIOS",
-    "parse_fault_spec",
+    "FaultSpec", "FaultyCache", "HOSTILE_BUILDERS", "InjectedFault",
+    "EXPECTED_STATUS", "SCENARIOS", "build_corpus",
+    "corrupt_boltdb_layout", "hostile_limits", "parse_fault_spec",
 ]

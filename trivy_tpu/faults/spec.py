@@ -56,6 +56,12 @@ class FaultSpec:
     #    deadline (the spec only carries the number)
     deadline_s: float = 0.0
 
+    # -- hostile-ingest corpus (faults/hostile.py): builder names —
+    #    or ("all",) — materialized (seeded by ``seed``) and appended
+    #    to the scanned fleet by the multi-target image path; the
+    #    guard layer must quarantine each one per-target
+    hostile: tuple = ()
+
     def wants_cache_faults(self) -> bool:
         return bool(self.cache_fail_ops or self.cache_fail_rate)
 
@@ -87,6 +93,7 @@ SCENARIOS: dict = {
     "standard-outage": {"cache_fail_ops": 40,
                         "device_fail_batches": 1,
                         "poison": ("poison",)},
+    "hostile-ingest": {"hostile": ("all",)},
 }
 
 _FIELDS = {f.name: f for f in fields(FaultSpec)}

@@ -1,0 +1,43 @@
+"""Resource governance for the untrusted-input ingest path
+(docs/robustness.md "Untrusted input & resource budgets").
+
+The serving system scans artifacts it did not produce: a scan target
+is attacker-controlled bytes, and a single decompression-bomb layer,
+a million-entry tar, or a truncated gzip must never hang a coalesced
+device batch or OOM the host. This package is the budget half of
+that contract:
+
+* :mod:`budget` — per-scan :class:`ResourceBudget` (decompressed
+  bytes with a compression-ratio tripwire, entry count, per-file
+  size, path depth, per-stage wall-clock deadline) plus the typed
+  :class:`GuardError` hierarchy every trip raises, and the
+  process-wide :data:`GUARD_METRICS` counters that
+  ``sched/metrics.py`` and ``GET /metrics`` export;
+* :mod:`safetar` — bounded tar/gzip readers (traversal and link
+  escapes rejected after normpath, absurd/negative sizes rejected,
+  streams decompressed chunk-wise so a bomb trips the byte budget
+  instead of materializing) adopted by ``artifact/image.py``,
+  ``artifact/walker.py``, and ``db/lifecycle.py``.
+
+A budget trip surfaces through the PR-2 degraded-mode machinery:
+the poisoned slot resolves ``Status: failed`` (hard trip) or
+``degraded`` (soft fault) with an ``ingest``-stage FailureCause
+while its coalesced batchmates complete untouched.
+"""
+
+from .budget import (DEFAULT_LIMITS, GUARD_METRICS, GuardError,
+                     GuardMetrics, IngestDeadlineExceeded,
+                     MalformedArchiveError, ResourceBudget,
+                     ResourceBudgetExceeded, ResourceLimits,
+                     current_budget, make_budget)
+from .safetar import (decompress_bounded, open_layer_bytes,
+                      safe_extract_db_archive, validate_digest)
+
+__all__ = [
+    "DEFAULT_LIMITS", "GUARD_METRICS", "GuardError", "GuardMetrics",
+    "IngestDeadlineExceeded", "MalformedArchiveError",
+    "ResourceBudget", "ResourceBudgetExceeded", "ResourceLimits",
+    "current_budget", "decompress_bounded", "make_budget",
+    "open_layer_bytes", "safe_extract_db_archive",
+    "validate_digest",
+]

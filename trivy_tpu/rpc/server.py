@@ -33,10 +33,20 @@ SCANNER_PREFIX = "/twirp/trivy.scanner.v1.Scanner/"
 CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
 DEFAULT_TOKEN_HEADER = "Trivy-Token"
 IDEMPOTENCY_TTL_S = 300.0
+# admission control (docs/robustness.md "Untrusted input"): requests
+# beyond these caps answer 413 BEFORE any body is read or work is
+# queued — an oversized body or a 100k-blob Scan must cost the
+# server a header read, not memory or queue slots
+MAX_BODY_BYTES = 64 << 20
+MAX_SCAN_BLOBS = 1024
 
 
 class ServerDraining(RuntimeError):
     """New work refused: the server is shutting down (503)."""
+
+
+class RequestTooLarge(ValueError):
+    """Request exceeds the admission caps (413)."""
 
 
 class _IdemEntry:
@@ -116,7 +126,11 @@ class ScanServer:
     def __init__(self, store=None, cache=None,
                  cache_dir: str = "", token: str = "",
                  token_header: str = DEFAULT_TOKEN_HEADER,
-                 sched: str = "off", sched_config=None):
+                 sched: str = "off", sched_config=None,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 max_scan_blobs: int = MAX_SCAN_BLOBS):
+        self.max_body_bytes = max_body_bytes
+        self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
             self.store = store
         else:
@@ -229,9 +243,14 @@ class ScanServer:
                 "scan_removed_packages", False),
             backend=opts.get("backend", "tpu"),
         )
+        blob_ids = body.get("blob_ids") or []
+        if len(blob_ids) > self.max_scan_blobs:
+            raise RequestTooLarge(
+                f"scan request lists {len(blob_ids)} blobs "
+                f"(max {self.max_scan_blobs})")
         target = ScanTarget(name=body.get("target", ""),
                             artifact_id=body.get("artifact_id", ""),
-                            blob_ids=body.get("blob_ids") or [])
+                            blob_ids=blob_ids)
         if self.scheduler is not None:
             return self._scan_scheduled(target, options, body)
         # readers hold the store across the whole scan; swap waits
@@ -291,6 +310,13 @@ class ScanServer:
             else self.scheduler.stats()
         out["draining"] = self._draining
         out["idempotency"] = self._idem.stats()
+        if "guard" not in out:
+            # scheduler-off servers still report the ingest-guard
+            # counters (the scheduler's stats() already carry them)
+            from ..guard.budget import GUARD_METRICS
+            out["guard"] = GUARD_METRICS.snapshot()
+        out["admission"] = {"max_body_bytes": self.max_body_bytes,
+                            "max_scan_blobs": self.max_scan_blobs}
         breaker = getattr(self.cache, "breaker_stats", None)
         if callable(breaker):
             out["cache_breaker"] = breaker()
@@ -412,7 +438,23 @@ def _make_handler(server: ScanServer):
                 self._reply(500, {"code": "injected",
                                   "msg": "injected rpc error"})
                 return
-            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                self._reply(400, {"code": "malformed",
+                                  "msg": "bad content-length"})
+                self.close_connection = True
+                return
+            if length > server.max_body_bytes or length < 0:
+                # admission cap: answer 413 WITHOUT reading the
+                # body; the unread stream makes the connection
+                # unusable for keep-alive, so close it
+                self._reply(413, {
+                    "code": "payload_too_large",
+                    "msg": f"request body of {length} bytes "
+                           f"exceeds {server.max_body_bytes}"})
+                self.close_connection = True
+                return
             raw = self.rfile.read(length) if length else b"{}"
             try:
                 body = json.loads(raw or b"{}")
@@ -426,6 +468,12 @@ def _make_handler(server: ScanServer):
             except LookupError:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
+                return
+            except RequestTooLarge as e:
+                # admission cap on request SHAPE (e.g. blob count):
+                # 413 is authoritative, not retryable
+                self._reply(413, {"code": "payload_too_large",
+                                  "msg": str(e)})
                 return
             except QueueFullError as e:
                 # backpressure: 503 is the transient code the client

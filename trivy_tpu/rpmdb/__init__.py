@@ -18,21 +18,31 @@ from .sqlite import is_sqlite, sqlite_blobs
 
 def list_packages(data: bytes) -> list:
     """rpmdb file bytes → [RpmPackage]; raises ValueError on an
-    unrecognized or corrupt database."""
-    if is_sqlite(data):
-        blobs = sqlite_blobs(data)
-    elif is_bdb(data):
-        blobs = bdb_blobs(data)
-    elif is_ndb(data):
-        blobs = ndb_blobs(data)
-    else:
-        raise ValueError("unrecognized rpmdb format")
-    out = []
-    for blob in blobs:
-        pkg = parse_header_blob(blob)
-        if pkg is not None and pkg.name:
-            out.append(pkg)
-    return out
+    unrecognized or corrupt database. Any parser crash on crafted
+    bytes (struct/index/unicode errors deep in the page walkers) is
+    normalized to ValueError so callers need exactly one corrupt-db
+    error path."""
+    import struct
+    try:
+        if is_sqlite(data):
+            blobs = sqlite_blobs(data)
+        elif is_bdb(data):
+            blobs = bdb_blobs(data)
+        elif is_ndb(data):
+            blobs = ndb_blobs(data)
+        else:
+            raise ValueError("unrecognized rpmdb format")
+        out = []
+        for blob in blobs:
+            pkg = parse_header_blob(blob)
+            if pkg is not None and pkg.name:
+                out.append(pkg)
+        return out
+    except ValueError:
+        raise
+    except (struct.error, IndexError, KeyError, OverflowError,
+            MemoryError, UnicodeError) as e:
+        raise ValueError(f"corrupt rpmdb: {e!r}") from e
 
 
 __all__ = ["list_packages", "RpmPackage", "parse_header_blob",

@@ -61,14 +61,26 @@ def bdb_blobs(data: bytes) -> list:
         return off
 
     def overflow_chain(pgno: int, total: int) -> bytes:
+        # hostile-input bounds: a crafted chain that cycles (or
+        # chains zero-payload pages forever) must raise, not spin —
+        # every page can legitimately appear at most once
+        if total > len(data):
+            raise ValueError(
+                f"overflow length {total} exceeds file size")
         out = bytearray()
+        seen = set()
         while pgno != 0 and len(out) < total:
+            if pgno in seen:
+                raise ValueError("cyclic overflow chain")
+            seen.add(pgno)
             off = page(pgno)
             ptype = data[off + 25]
             if ptype != P_OVERFLOW:
                 raise ValueError("broken overflow chain")
             nxt = u32(off + 16)
             hf_offset = u16(off + 22)
+            if hf_offset == 0:
+                raise ValueError("empty overflow page in chain")
             out += data[off + 26:off + 26 + hf_offset]
             pgno = nxt
         return bytes(out[:total])

@@ -136,13 +136,15 @@ class BatchScanRunner:
             # work that should overlap device execution too
             return self._scan_scheduled(
                 [(p, None) for p in paths], options)
+        import tarfile as _tarfile
         images, failures = [], {}
         for i, p in enumerate(paths):
             try:
                 if self.fault_injector is not None:
                     self.fault_injector.on_image_load(p)
-                images.append((i, load_image(p)))
-            except (OSError, ValueError) as e:
+                images.append((i, load_image(
+                    p, budget=self._ingest_budget(p))))
+            except (OSError, ValueError, _tarfile.TarError) as e:
                 failures[i] = _failed_slot(p, e)
         results = self.scan_images([img for _, img in images],
                                    options)
@@ -160,6 +162,17 @@ class BatchScanRunner:
         from ..utils import defer_gc
         with defer_gc():
             return self._scan_images(images, options)
+
+    def _ingest_budget(self, name: str):
+        """Fresh per-target ResourceBudget (docs/robustness.md), or
+        None when the runner's artifact option disabled the guards
+        (``--no-ingest-guards``)."""
+        from ..guard.budget import make_budget
+        opt = self.artifact_option
+        enabled = opt.ingest_guards if opt is not None else True
+        return make_budget(
+            getattr(opt, "ingest_limits", None) if opt else None,
+            enabled=enabled, name=name)
 
     def _image_opt(self, scan_secrets: bool) -> ArtifactOption:
         """Per-scan artifact option: the runner-level template (CLI
@@ -230,9 +243,12 @@ class BatchScanRunner:
                 # slot only; a slow-host stall eats into the deadline
                 inj.on_host_analyze(name)
                 inj.on_image_load(name)
-            img = image if image is not None else load_image(name)
+            budget = self._ingest_budget(name)
+            img = image if image is not None \
+                else load_image(name, budget=budget)
             opt = self._image_opt(scan_secrets)
-            a = _SchedImageArtifact(img, self.cache, opt)
+            a = _SchedImageArtifact(img, self.cache, opt,
+                                    budget=budget)
             # register pending blob writes BEFORE the analyzed blobs
             # land in the cache (the _batch_secrets hook fires between
             # analysis and put_blob), so a concurrent request can
@@ -242,6 +258,12 @@ class BatchScanRunner:
             a._sched_req = req
             ref = a.inspect()
             a.reference = ref
+            if a.budget is not None:
+                # survivable hostile input (e.g. a corrupt rpmdb):
+                # the slot completes but reports status=degraded
+                # with ingest-stage causes
+                for kind, msg in a.budget.soft_faults:
+                    req.record_fault("ingest", kind, msg)
             scanner = LocalScanner(self.cache, self.store)
             prepared = scanner.prepare(
                 ScanTarget(name=ref.name, artifact_id=ref.id,
@@ -299,12 +321,21 @@ class BatchScanRunner:
 
         # ---- phase 1: analyze missing layers, collect candidates ----
         t0 = _time.perf_counter()
-        artifacts = []
+        slots, failures = [], {}     # [(input idx, artifact)]
         opt = self._image_opt(scan_secrets)
-        for img in images:
+        for idx, img in enumerate(images):
             a = _CollectingImageArtifact(img, self.cache, opt)
-            a.reference = a.inspect()
-            artifacts.append(a)
+            try:
+                a.reference = a.inspect()
+            except Exception as e:   # noqa: BLE001 — a hostile or
+                # broken artifact fails ITS slot with a typed cause;
+                # the fleet keeps scanning (same isolation the
+                # scheduled path gets from per-request analyze)
+                failures[idx] = _failed_slot(
+                    getattr(img, "name", ""), e)
+                continue
+            slots.append((idx, a))
+        artifacts = [a for _, a in slots]
         analyze_s = _time.perf_counter() - t0
 
         # ---- phase 2a: ENQUEUE the sieve dispatch (async) ----
@@ -382,12 +413,12 @@ class BatchScanRunner:
         }
 
         # ---- phase 5: assemble per image ----
-        out = []
-        for idx, (a, p) in enumerate(zip(artifacts, prepared)):
+        out = dict(failures)
+        for local, ((idx, a), p) in enumerate(zip(slots, prepared)):
             results, os_found = scanner.finish(
-                p, detected_by_image.get(idx, []))
+                p, detected_by_image.get(local, []))
             ref = a.reference
-            out.append(BatchScanResult(
+            res = BatchScanResult(
                 name=ref.name,
                 report=Report(
                     artifact_name=ref.name,
@@ -400,8 +431,14 @@ class BatchScanRunner:
                         image_config=ref.image_metadata.image_config,
                     ),
                     results=results,
-                )))
-        return out
+                ))
+            b = getattr(a, "budget", None)
+            if b is not None and b.soft_faults:
+                res.apply_degraded(
+                    [{"stage": "ingest", "kind": k, "message": m}
+                     for k, m in b.soft_faults])
+            out[idx] = res
+        return [out[i] for i in range(len(images))]
 
 
     def scan_boms(self, boms: list,
@@ -577,15 +614,24 @@ def _failed_slot(name: str, err: BaseException) -> BatchScanResult:
     typed scheduler errors map to distinct kinds so a caller can
     tell backpressure (retryable) from deadline (not) from a broken
     image."""
+    import tarfile as _tarfile
+
+    from ..guard.budget import GuardError
     from ..sched import (DeadlineExceeded, QueueFullError,
                          SchedulerClosed)
-    if isinstance(err, DeadlineExceeded):
+    if isinstance(err, GuardError):
+        # ingest-guard trip (docs/robustness.md): resource-budget
+        # (bombs, floods, deadlines) or malformed-archive
+        # (traversal, truncation, undecodable names)
+        stage, kind = err.stage, err.kind
+    elif isinstance(err, DeadlineExceeded):
         stage, kind = "sched", "deadline_exceeded"
     elif isinstance(err, QueueFullError):
         stage, kind = "sched", "queue_full"
     elif isinstance(err, SchedulerClosed):
         stage, kind = "sched", "shutdown"
-    elif isinstance(err, (OSError, ValueError)):
+    elif isinstance(err, (OSError, ValueError,
+                          _tarfile.TarError)):
         stage, kind = "host", "load_failed"
     else:
         stage, kind = "sched", "error"

@@ -224,4 +224,9 @@ class SchedMetrics:
                 "latency": {p: h.to_dict()
                             for p, h in self.hist.items()},
             }
+        # ingest-guard counters (trivy_tpu/guard): process-wide by
+        # design — budgets are per-target and short-lived, the trip
+        # totals are what an operator watches on /metrics
+        from ..guard.budget import GUARD_METRICS
+        out["guard"] = GUARD_METRICS.snapshot()
         return out
