@@ -923,12 +923,88 @@ def bench_hostile() -> dict:
         }
 
 
+N_OBS_IMAGES = 64
+
+
+def bench_obs() -> dict:
+    """Tracing overhead gate (docs/observability.md): the 64-image
+    clean fleet scanned through the scheduler with tracing fully
+    disabled vs enabled. Asserts the traced run's reports stay
+    byte-identical and that clean-fleet tracing overhead is < 2%.
+    Like the hostile bench's guard gate, the asserted overhead is
+    ATTRIBUTED — measured per-span cost x the spans one fleet run
+    records / the untraced wall — because shared-host wall noise is
+    several times the whole effect; the raw paired walls are
+    reported alongside."""
+    import tempfile
+
+    from trivy_tpu.obs import Tracer
+    from trivy_tpu.runtime import BatchScanRunner
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = make_fleet(tmp, N_OBS_IMAGES)
+        store = make_store()
+
+        def run(tracer):
+            runner = BatchScanRunner(store=store, backend="tpu",
+                                     sched=_sched_cfg(),
+                                     tracer=tracer)
+            t0 = time.perf_counter()
+            res = runner.scan_paths(paths)
+            dt = time.perf_counter() - t0
+            runner.close()
+            return dt, res
+
+        run(Tracer())                    # warm-up (compiles)
+        off_runs = [run(Tracer(enabled=False)) for _ in range(3)]
+        tracer = Tracer()
+        on_runs = [run(tracer) for _ in range(3)]
+        off_s = min(dt for dt, _ in off_runs)
+        on_s = min(dt for dt, _ in on_runs)
+        assert _norm(on_runs[0][1]) == _norm(off_runs[0][1]), \
+            "reports diverged with tracing enabled"
+
+        spans_per_run = tracer.n_spans / 3
+        spans_per_request = spans_per_run / len(paths)
+
+        # per-span micro cost: a start+end round trip through the
+        # tracer (recorder ring churn included), CPU time
+        micro = Tracer()
+        n = 20_000
+        t0 = time.process_time()
+        for _ in range(n):
+            root = micro.start_request("bench")
+            child = micro.child(root, "analyze")
+            child.end()
+            root.end()
+        per_span_s = (time.process_time() - t0) / (2 * n)
+
+        overhead = per_span_s * spans_per_run / off_s
+        assert overhead < 0.02, \
+            f"clean-fleet tracing overhead {overhead:.2%} >= 2% " \
+            f"({per_span_s * 1e6:.2f}us/span x {spans_per_run:.0f} " \
+            f"spans over {off_s:.2f}s)"
+
+        return {
+            "images": len(paths),
+            "untraced_s": round(off_s, 3),
+            "traced_s": round(on_s, 3),
+            "raw_wall_ratio": round(on_s / off_s, 4),
+            "tracing_overhead": round(overhead, 6),
+            "span_cost_us": round(per_span_s * 1e6, 3),
+            "spans_per_request": round(spans_per_request, 2),
+            "traces_per_run": round(tracer.n_traces / 3, 1),
+            "recorder": tracer.recorder.stats(),
+        }
+
+
 def _run_config(cfg: str) -> dict:
     return {"images": bench_images, "sboms": bench_sboms,
             "mesh": bench_mesh_scaling,
             "serving": bench_serving,
             "faults": bench_faults,
-            "hostile": bench_hostile}[cfg]()
+            "hostile": bench_hostile,
+            "obs": bench_obs}[cfg]()
 
 
 def _subprocess_config(cfg: str) -> dict:
@@ -975,6 +1051,7 @@ def main() -> None:
     mesh = _subprocess_config("mesh")
     faults = _subprocess_config("faults")
     hostile = _subprocess_config("hostile")
+    obs = _subprocess_config("obs")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -1000,6 +1077,7 @@ def main() -> None:
         "mesh_scaling": mesh,
         "faults": faults,
         "hostile": hostile,
+        "obs": obs,
     }))
 
 

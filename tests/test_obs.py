@@ -1,0 +1,598 @@
+"""Observability suite (docs/observability.md; ``pytest -m obs``).
+
+Trace completeness over both scheduler modes (every submitted
+request yields exactly one root span whose children cover
+queue/host/device/report, with no negative or parent-escaping
+durations), the poison-image span tree (bisect retries + quarantine
+host-fallback as child spans, degraded report referencing its trace
+id), Prometheus exposition syntax on ``GET /metrics``, the
+``/trace/<id>`` endpoint, flight-recorder ring eviction, structured
+JSON logs carrying trace ids, and byte-identical reports with
+tracing enabled.
+"""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from tests.test_sched import _norm, make_fleet, make_store
+from trivy_tpu.obs import FlightRecorder, Tracer, render_prometheus
+from trivy_tpu.sched import SchedConfig
+
+pytestmark = pytest.mark.obs
+
+
+def _spans_by_request(tracer):
+    """{request name: [spans]} for every COMPLETED trace."""
+    out = {}
+    for _tid, spans in tracer.recorder.traces():
+        root = next(s for s in spans if s.parent_id is None)
+        out[root.attrs.get("request", "")] = spans
+    return out
+
+
+def _root(spans):
+    return next(s for s in spans if s.parent_id is None)
+
+
+def _check_tree(spans):
+    """Structural invariants: exactly one root, every child parented
+    inside the tree, no negative durations, children nested inside
+    their parent's interval (small scheduling epsilon)."""
+    roots = [s for s in spans if s.parent_id is None]
+    assert len(roots) == 1
+    by_id = {s.span_id: s for s in spans}
+    eps = 1e-4
+    for s in spans:
+        assert s.end_mono is not None, f"span {s.name} never ended"
+        assert s.duration_s >= 0.0
+        if s.parent_id is None:
+            continue
+        parent = by_id.get(s.parent_id)
+        assert parent is not None, \
+            f"span {s.name} parented outside its trace"
+        assert s.start_mono >= parent.start_mono - eps
+        assert s.end_mono <= parent.end_mono + eps, \
+            f"span {s.name} escapes its parent {parent.name}"
+
+
+# ---------------------------------------------------------------
+# span / tracer units
+# ---------------------------------------------------------------
+
+class TestTracer:
+    def test_span_tree_and_chrome_export(self):
+        t = Tracer()
+        root = t.start_request("img.tar")
+        child = t.child(root, "analyze")
+        child.event("guard_trip", kind="resource-budget")
+        child.end()
+        root.end()
+        assert re.fullmatch(r"[0-9a-f]{32}", root.trace_id)
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        doc = t.trace(root.trace_id)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "scan" in names and "analyze" in names \
+            and "guard_trip" in names
+        x = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert all(e["dur"] >= 0 for e in x)
+        assert all(e["args"]["trace_id"] == root.trace_id
+                   for e in x)
+        # Perfetto wants a JSON object with a traceEvents array
+        json.dumps(doc)
+
+    def test_disabled_tracer_is_noop(self):
+        t = Tracer(enabled=False)
+        root = t.start_request("img.tar")
+        assert root.noop
+        child = t.child(root, "analyze")
+        child.event("x")
+        child.end()
+        root.end("failed")
+        assert t.n_spans == 0 and t.recorder.traces() == []
+
+    def test_external_trace_id_honored_and_sanitized(self):
+        t = Tracer()
+        tid = "ab" * 16
+        root = t.start_request("img.tar", trace_id=tid)
+        assert root.trace_id == tid
+        root.end()
+        # hostile ids (the id becomes a dump FILE NAME) are replaced
+        for evil_id in ("../../etc/x", "ab" * 16 + "\n", "AB" * 999):
+            evil = t.start_request("img.tar", trace_id=evil_id)
+            assert re.fullmatch(r"[0-9a-f]{32}", evil.trace_id)
+            assert evil.trace_id != evil_id
+            evil.end()
+
+    def test_depth_gauge_called_outside_metrics_lock(self):
+        """Regression: snapshot() used to call the live depth gauge
+        under the (non-reentrant) metrics lock — a gauge touching
+        the metrics deadlocked."""
+        from trivy_tpu.sched import SchedMetrics
+        m = SchedMetrics()
+        m.set_depth_gauge(lambda: m.in_flight())
+        out = {}
+        th = threading.Thread(
+            target=lambda: out.setdefault("snap", m.snapshot()))
+        th.start()
+        th.join(timeout=5)
+        assert not th.is_alive(), "snapshot deadlocked on the gauge"
+        assert out["snap"]["queue_depth"] == 0
+
+    def test_histogram_bisect_and_subms_buckets(self):
+        from trivy_tpu.sched import LatencyHistogram
+        h = LatencyHistogram()
+        assert h.BOUNDS[0] == 0.0001 and 0.00025 in h.BOUNDS \
+            and 0.0005 in h.BOUNDS
+        assert list(h.BOUNDS) == sorted(h.BOUNDS)
+        # sub-ms observations spread over distinct buckets instead
+        # of collapsing into the first one
+        for v in (0.00005, 0.0002, 0.0004, 0.0009):
+            h.observe(v)
+        assert h.counts[0] == 1 and h.counts[1] == 1 \
+            and h.counts[2] == 1 and h.counts[3] == 1
+        h.observe(1000.0)              # past the last bound
+        assert h.counts[len(h.BOUNDS)] == 1
+        assert h.total == 5
+        # boundary values land in the bucket whose bound equals them
+        # (same as the old linear `v <= b` scan)
+        h2 = LatencyHistogram()
+        h2.observe(0.0001)
+        assert h2.counts[0] == 1
+        d = h2.to_dict()
+        assert d["count"] == 1 and d["max_s"] == 0.0001
+
+
+# ---------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_eviction(self):
+        rec = FlightRecorder(capacity=4)
+        t = Tracer(recorder=rec)
+        ids = []
+        for i in range(6):
+            root = t.start_request(f"img{i}.tar")
+            root.end()
+            ids.append(root.trace_id)
+        assert rec.stats()["traces"] == 4
+        assert rec.stats()["evicted"] == 2
+        assert rec.get(ids[0]) is None and rec.get(ids[1]) is None
+        assert rec.get(ids[-1]) is not None
+
+    def test_log_ring_capped(self):
+        rec = FlightRecorder(log_capacity=8)
+        for i in range(20):
+            rec.note_log({"msg": f"m{i}"})
+        logs = rec.recent_logs()
+        assert len(logs) == 8 and logs[-1]["msg"] == "m19"
+
+    def test_rejected_requests_never_dump(self, tmp_path):
+        """A backpressure storm (503s) must not become a disk-write
+        storm: only degraded/failed traces crash-dump."""
+        rec = FlightRecorder(dump_dir=str(tmp_path / "dumps"))
+        t = Tracer(recorder=rec)
+        for i in range(5):
+            root = t.start_request(f"img{i}.tar")
+            root.end("rejected")
+        assert rec.dumps == 0
+        assert not (tmp_path / "dumps").exists()
+
+    def test_dump_files_fifo_capped(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(FlightRecorder, "DUMP_CAP", 3)
+        rec = FlightRecorder(dump_dir=str(tmp_path / "dumps"))
+        t = Tracer(recorder=rec)
+        for i in range(5):
+            root = t.start_request(f"img{i}.tar")
+            root.end("failed")
+        assert rec.dumps == 5
+        assert len(list((tmp_path / "dumps").glob("*.json"))) == 3
+
+    def test_default_dump_dir_is_uid_scoped(self):
+        import os
+        rec = FlightRecorder()
+        uid = getattr(os, "getuid", lambda: "")()
+        assert rec.dump_dir.endswith(f"trivy-tpu-traces-{uid}")
+
+    def test_degraded_trace_dumped_to_disk(self, tmp_path):
+        rec = FlightRecorder(dump_dir=str(tmp_path / "dumps"))
+        t = Tracer(recorder=rec)
+        root = t.start_request("img.tar")
+        t.child(root, "analyze").end()
+        root.end("degraded")
+        path = rec.dump_path(root.trace_id)
+        assert rec.dumps == 1
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert any(e["name"] == "scan" for e in doc["traceEvents"])
+        assert "recent_logs" in doc["otherData"]
+
+
+# ---------------------------------------------------------------
+# end-to-end trace completeness (both sched modes)
+# ---------------------------------------------------------------
+
+def _run_fleet(tmp_path, n, sched, tracer, injector=None,
+               cfg=None):
+    from trivy_tpu.runtime import BatchScanRunner
+    paths = make_fleet(tmp_path, n)
+    runner = BatchScanRunner(
+        store=make_store(), backend="cpu-ref",
+        sched=(cfg or SchedConfig(workers=2)) if sched == "on"
+        else "off",
+        tracer=tracer, fault_injector=injector)
+    try:
+        results = runner.scan_paths(paths)
+    finally:
+        runner.close()
+    return paths, results
+
+
+class TestTraceCompleteness:
+    def test_sched_on_every_request_traced(self, tmp_path):
+        tracer = Tracer()
+        paths, results = _run_fleet(tmp_path, 5, "on", tracer)
+        assert all(r.status == "ok" for r in results)
+        by_req = _spans_by_request(tracer)
+        assert sorted(by_req) == sorted(paths)
+        for path in paths:
+            spans = by_req[path]
+            _check_tree(spans)
+            kids = {s.name for s in spans if s.parent_id}
+            assert {"queue_wait", "analyze", "coalesce", "device",
+                    "report"} <= kids
+            assert _root(spans).status == "ok"
+
+    def test_sched_off_every_request_traced(self, tmp_path):
+        tracer = Tracer()
+        paths, results = _run_fleet(tmp_path, 4, "off", tracer)
+        assert all(r.status == "ok" for r in results)
+        by_req = _spans_by_request(tracer)
+        assert sorted(by_req) == sorted(paths)
+        for path in paths:
+            spans = by_req[path]
+            _check_tree(spans)
+            kids = {s.name for s in spans if s.parent_id}
+            assert {"analyze", "device", "report"} <= kids
+
+    def test_poison_trace_shows_bisect_and_fallback(self, tmp_path,
+                                                    make_faults):
+        inj = make_faults("poison-image:poison=img1.tar")
+        tracer = Tracer()
+        # a real batching window so the poison rides a shared batch
+        cfg = SchedConfig(workers=4, flush_timeout_s=0.2,
+                          eager_idle_flush=False)
+        paths, results = _run_fleet(tmp_path, 4, "on", tracer,
+                                    injector=inj, cfg=cfg)
+        poisoned = [r for r in results if "img1.tar" in r.name][0]
+        assert poisoned.status == "degraded"
+        # the degraded report references its trace id
+        obs_causes = [c for c in poisoned.causes
+                      if c.stage == "obs" and c.kind == "trace"]
+        assert len(obs_causes) == 1
+        spans = _spans_by_request(tracer)[poisoned.name]
+        trace_id = _root(spans).trace_id
+        assert trace_id in obs_causes[0].message
+        # span tree: >= 2 device attempts (the failed dispatch plus
+        # the bounded quarantine retry), then the host fallback
+        device = [s for s in spans if s.name == "device"]
+        assert len(device) >= 2
+        assert any(s.attrs.get("attempt") == "quarantine_retry"
+                   for s in device)
+        assert any(s.name == "host_fallback" for s in spans)
+        root = _root(spans)
+        assert root.status == "degraded"
+        events = [name for _, name, _ in root.events]
+        assert "quarantined" in events
+        # the degraded trace auto-dumped to the flight recorder dir
+        assert tracer.recorder.dumps >= 1
+        # other requests in the shared batch record the bisect
+        if any(s.attrs.get("bisect_depth") for s in device):
+            assert "batch_bisect" in events
+
+    def test_byte_identical_reports_with_tracing(self, tmp_path):
+        _, traced = _run_fleet(tmp_path, 3, "on", Tracer())
+        _, untraced = _run_fleet(tmp_path, 3, "on",
+                                 Tracer(enabled=False))
+        assert _norm(traced) == _norm(untraced)
+
+    def test_cli_trace_out_poison_e2e(self, tmp_path, capsys):
+        """Acceptance: --fault-spec poison-image + --trace-out on a
+        batch scan produces Perfetto-loadable trace JSON in which
+        the poisoned request's tree shows the quarantine fallback,
+        and the degraded report references its trace id."""
+        from trivy_tpu.cli import main
+        from trivy_tpu.obs import get_tracer
+        paths = make_fleet(tmp_path, 3)
+        out_dir = tmp_path / "traces"
+        out_file = tmp_path / "report.json"
+        code = main(["image", *paths,
+                     "--fault-spec", "poison-image:poison=img1.tar",
+                     "--trace-out", str(out_dir),
+                     "--backend", "cpu-ref",
+                     "--cache-dir", str(tmp_path / "cache"),
+                     "--no-cache",
+                     "--format", "json", "-o", str(out_file)])
+        try:
+            assert code == 0
+            files = sorted(out_dir.glob("trace-*.json"))
+            assert len(files) == 3
+            poisoned_doc = None
+            for f in files:
+                doc = json.loads(f.read_text())
+                assert doc["traceEvents"], f"{f} empty"
+                root = [e for e in doc["traceEvents"]
+                        if e.get("name") == "scan"][0]
+                if "img1.tar" in root["args"].get("request", ""):
+                    poisoned_doc = doc
+            assert poisoned_doc is not None
+            names = [e["name"] for e in poisoned_doc["traceEvents"]]
+            assert "host_fallback" in names
+            assert names.count("device") >= 2
+            # the degraded slot's report references the trace
+            reports = json.loads(out_file.read_text())
+            bad = [r for r in reports
+                   if "img1.tar" in r["ArtifactName"]][0]
+            assert bad["Status"] == "degraded"
+            obs = [c for c in bad["FailureCauses"]
+                   if c["Stage"] == "obs"]
+            assert obs and "trace " in obs[0]["Message"]
+        finally:
+            # the CLI pointed the PROCESS tracer at tmp_path
+            get_tracer().export_dir = ""
+
+
+# ---------------------------------------------------------------
+# prometheus exposition + endpoints
+# ---------------------------------------------------------------
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    rf"(\{{{_LABEL}(,{_LABEL})*\}})?"
+    r" (NaN|[+-]?Inf|[-+0-9.eE]+)$")
+
+
+def _check_exposition(text):
+    """Syntax + histogram invariants of one exposition document."""
+    assert text.endswith("\n")
+    seen_types = {}
+    samples = 0
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert mtype in ("counter", "gauge", "histogram")
+            assert name not in seen_types, f"duplicate TYPE {name}"
+            seen_types[name] = mtype
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        samples += 1
+    assert samples > 0
+    # histogram invariants: cumulative buckets, +Inf == _count
+    hists = [n for n, t in seen_types.items() if t == "histogram"]
+    for name in hists:
+        series = {}
+        for line in text.splitlines():
+            if not line.startswith(name + "_bucket"):
+                continue
+            labels = dict(
+                kv.split("=", 1)
+                for kv in line[line.index("{") + 1:
+                               line.index("}")].split(","))
+            le = labels.pop("le").strip('"')
+            key = tuple(sorted(labels.items()))
+            series.setdefault(key, []).append(
+                (le, float(line.rsplit(" ", 1)[1])))
+        for key, buckets in series.items():
+            counts = [c for _, c in buckets]
+            assert counts == sorted(counts), \
+                f"{name}{key}: buckets not cumulative"
+            assert buckets[-1][0] == "+Inf"
+            count_line = [
+                ln for ln in text.splitlines()
+                if ln.startswith(name + "_count") and
+                all(f'{k}="{v}"'.strip('"') in ln or
+                    f'{k}={v}' in ln for k, v in key)]
+            assert count_line
+            assert float(count_line[0].rsplit(" ", 1)[1]) == \
+                buckets[-1][1]
+    return seen_types
+
+
+class TestPrometheus:
+    def test_render_syntax_from_live_scheduler(self, tmp_path):
+        from trivy_tpu.runtime import BatchScanRunner
+        paths = make_fleet(tmp_path, 3)
+        tracer = Tracer()
+        runner = BatchScanRunner(store=make_store(),
+                                 backend="cpu-ref",
+                                 sched=SchedConfig(workers=2),
+                                 tracer=tracer)
+        try:
+            runner.scan_paths(paths)
+            stats = runner.scheduler.stats()
+            hists = runner.scheduler.metrics.hist_snapshot()
+        finally:
+            runner.close()
+        text = render_prometheus(
+            stats, phase_hists=hists,
+            trace_hists=tracer.phase_snapshot(),
+            tracer_stats=tracer.stats(),
+            recorder_stats=tracer.recorder.stats())
+        types = _check_exposition(text)
+        assert types["trivy_tpu_sched_events_total"] == "counter"
+        assert types["trivy_tpu_sched_phase_latency_seconds"] == \
+            "histogram"
+        assert types["trivy_tpu_trace_span_seconds"] == "histogram"
+        assert 'event="completed"} 3' in text
+
+    def test_label_escaping(self):
+        text = render_prometheus(
+            {"counters": {'we"ird\nname\\x': 1}})
+        _check_exposition(text)
+        assert '\\"' in text and "\\n" in text
+
+    def test_server_content_negotiation_and_trace_endpoint(self):
+        import urllib.error
+        import urllib.request
+        from trivy_tpu.rpc.server import ScanServer, serve
+        tracer = Tracer()
+        server = ScanServer(sched="on", tracer=tracer)
+        httpd, _ = serve(port=0, server=server)
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            trace_id = "ab" * 16
+            body = {"trace_id": trace_id, "target": "t",
+                    "artifact_id": "a", "blob_ids": []}
+            req = urllib.request.Request(
+                base + "/twirp/trivy.scanner.v1.Scanner/Scan",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            assert urllib.request.urlopen(req).status == 200
+
+            # default stays JSON
+            doc = json.load(urllib.request.urlopen(
+                base + "/metrics"))
+            assert doc["counters"]["completed"] == 1
+            assert doc["trace"]["traces"] == 1
+
+            # Accept: text/plain -> Prometheus exposition
+            r = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "text/plain"})
+            resp = urllib.request.urlopen(r)
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            _check_exposition(resp.read().decode())
+
+            # the client's trace_id is queryable
+            trace = json.load(urllib.request.urlopen(
+                base + f"/trace/{trace_id}"))
+            names = {e["name"] for e in trace["traceEvents"]}
+            assert {"scan", "queue_wait", "analyze",
+                    "report"} <= names
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    base + "/trace/" + "00" * 16)
+            assert ei.value.code == 404
+        finally:
+            server.close()
+            httpd.shutdown()
+
+    def test_trace_endpoint_honors_token(self):
+        import urllib.error
+        import urllib.request
+        from trivy_tpu.rpc.server import ScanServer, serve
+        server = ScanServer(token="sekrit")
+        httpd, _ = serve(port=0, server=server)
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/trace/" + "ab" * 16)
+            assert ei.value.code == 401
+        finally:
+            server.close()
+            httpd.shutdown()
+
+
+# ---------------------------------------------------------------
+# structured logging
+# ---------------------------------------------------------------
+
+class TestJsonLogs:
+    def test_json_lines_carry_trace_ids(self):
+        import io
+        import logging
+        from trivy_tpu.utils.log import JsonFormatter, get_logger
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.setFormatter(JsonFormatter())
+        log = get_logger("obs.test")
+        log.addHandler(handler)
+        try:
+            t = Tracer()
+            root = t.start_request("img7.tar")
+            with root.activate():
+                log.warning("inside %s", "a-span")
+            log.warning("outside")
+            root.end()
+        finally:
+            log.removeHandler(handler)
+        lines = [json.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert lines[0]["msg"] == "inside a-span"
+        assert lines[0]["trace_id"] == root.trace_id
+        assert lines[0]["request_id"] == "img7.tar"
+        assert lines[0]["level"] == "WARNING"
+        assert "trace_id" not in lines[1]
+
+    def test_set_format_round_trip(self):
+        import io
+        from trivy_tpu.utils import log as logmod
+        logger = logmod.get_logger("obs.fmt")
+        buf = io.StringIO()
+        old_stream = logmod._h.setStream(buf)
+        try:
+            logmod.set_format("json")
+            logger.warning("structured")
+            rec = json.loads(
+                buf.getvalue().strip().splitlines()[-1])
+            assert rec["msg"] == "structured"
+            logmod.set_format("text")
+            logger.warning("plain again")
+            assert "\tWARNING\tplain again" in buf.getvalue()
+        finally:
+            logmod.set_format("text")
+            logmod._h.setStream(old_stream)
+        with pytest.raises(ValueError):
+            logmod.set_format("yaml")
+
+    def test_ring_handler_captures_tail(self):
+        from trivy_tpu.obs.recorder import RingLogHandler
+        from trivy_tpu.utils.log import get_logger
+        rec = FlightRecorder(log_capacity=16)
+        handler = RingLogHandler(rec)
+        log = get_logger("obs.ring")
+        log.addHandler(handler)
+        try:
+            t = Tracer(recorder=rec)
+            root = t.start_request("imgX.tar")
+            with root.activate():
+                log.warning("ringed")
+            root.end()
+        finally:
+            log.removeHandler(handler)
+        tail = rec.recent_logs()
+        assert tail and tail[-1]["msg"] == "ringed"
+        assert tail[-1]["trace_id"] == root.trace_id
+
+
+# ---------------------------------------------------------------
+# rpc propagation
+# ---------------------------------------------------------------
+
+class TestRpcPropagation:
+    def test_client_generates_and_sends_trace_id(self, monkeypatch):
+        from trivy_tpu.rpc.client import RemoteScanner
+        from trivy_tpu.scan.local import ScanTarget
+        from trivy_tpu.types import ScanOptions
+        sent = {}
+
+        def fake_call(self, path, body):
+            sent.update(body)
+            return {"os": None, "results": []}
+
+        monkeypatch.setattr(RemoteScanner, "call", fake_call)
+        client = RemoteScanner("http://x")
+        client.scan(ScanTarget(name="t", artifact_id="a",
+                               blob_ids=[]), ScanOptions())
+        assert re.fullmatch(r"[0-9a-f]{32}", sent["trace_id"])
+        assert client.last_trace_id == sent["trace_id"]

@@ -151,6 +151,12 @@ class ImageArtifact:
 
             todo = [i for i, b in enumerate(blob_ids)
                     if b in missing]
+            # tracing: the analyze span (active on this thread when
+            # the runner/scheduler traces the request) records how
+            # much of the image was a cache hit
+            from ..obs.trace import add_event
+            add_event("inspect", layers=len(blob_ids),
+                      missing=len(todo))
             if todo:
                 self._inspect_layers(todo, blob_ids, base)
             if missing_artifact and \
@@ -220,6 +226,7 @@ class ImageArtifact:
 
     def _analyze_layers(self, todo: list, layer_results: list,
                         all_candidates: list, base: set) -> None:
+        from ..obs.trace import add_event
         for i in todo:
             layer = self.image.layers[i]
             result = AnalysisResult()
@@ -230,6 +237,8 @@ class ImageArtifact:
                     if self._skipped(path):
                         continue
                     self.group.analyze_file(result, path, read, size)
+            add_event("layer_analyzed", layer=i,
+                      files=len(files))
             layer_results.append((i, result, opq_dirs, wh_files))
             if result.os is not None:
                 # feeds the image-config history analyzer, like the

@@ -165,6 +165,10 @@ class ResilientCache:
                 raise
             self._inc("primary_errors")
             self.breaker.record_failure()
+            # visible in the request's trace (docs/observability.md)
+            from ..obs.trace import add_event
+            add_event("cache_degraded", op=op, error=repr(e),
+                      breaker=self.breaker.state)
             log.warning("%s %s failed (%r); degrading to %s",
                         self.name, op, e,
                         type(self.fallback).__name__)

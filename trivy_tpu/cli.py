@@ -175,6 +175,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "and safe-tar checks (differential "
                         "baseline; scanning untrusted artifacts "
                         "without guards is unsafe)")
+        sp.add_argument("--trace-out", default="",
+                        help="write one Perfetto-loadable trace-"
+                        "event JSON per request into this directory "
+                        "(multi-target image scans; "
+                        "docs/observability.md)")
+        sp.add_argument("--log-format", default="text",
+                        choices=["text", "json"],
+                        help="log line format; json lines carry "
+                        "trace_id/request_id so logs correlate "
+                        "with traces")
         sp.add_argument("--config", "-c", default="",
                         help="config file (default: trivy.yaml)")
         sp.add_argument("--server", default="",
@@ -328,6 +338,14 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--drain-timeout", type=float, default=30.0,
                      help="SIGTERM graceful-drain bound in seconds "
                      "(in-flight scans finish, new work gets 503)")
+    srv.add_argument("--trace-out", default="",
+                     help="export every completed request trace as "
+                     "Perfetto-loadable JSON into this directory "
+                     "(traces are also served at GET /trace/<id>)")
+    srv.add_argument("--log-format", default="text",
+                     choices=["text", "json"],
+                     help="log line format; json lines carry "
+                     "trace_id/request_id (docs/observability.md)")
 
     plug = sub.add_parser("plugin", help="manage plugins")
     plugsub = plug.add_subparsers(dest="plugin_command")
@@ -384,6 +402,8 @@ def main(argv=None) -> int:
         # inspected for --config or rewritten by env defaults
         apply_external_defaults(parser, raw_argv)
     args = parser.parse_args(argv)
+    from .utils.log import set_format
+    set_format(getattr(args, "log_format", "") or "text")
     timeout_s = 0.0
     if getattr(args, "timeout", ""):
         try:
@@ -716,6 +736,7 @@ def run_server(args) -> int:
                       file=sys.stderr)
                 return 2
         sched = cfg
+    _trace_out(args)
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
@@ -1218,6 +1239,7 @@ def _run_image_batch(args, targets: list) -> int:
         print(f"fault-spec: added {len(extra)} hostile artifacts "
               f"to the fleet (seed={injector.spec.seed})",
               file=sys.stderr)
+    trace_out = _trace_out(args)
     runner = BatchScanRunner(
         store=store, cache=cache, backend=backend,
         secret_scanner=opt.secret_scanner,
@@ -1244,7 +1266,24 @@ def _run_image_batch(args, targets: list) -> int:
             dump = dict(dump)
             dump["faults"] = injector.stats()
         print(json.dumps(dump, indent=2), file=sys.stderr)
+    if trace_out:
+        from .obs import get_tracer
+        print(f"traces written to {trace_out} "
+              f"({get_tracer().n_exported} total this process)",
+              file=sys.stderr)
     return _finish_many(args, results)
+
+
+def _trace_out(args) -> str:
+    """--trace-out: point the process tracer's exporter at the
+    directory (created if missing); every completed request trace
+    lands there as Perfetto-loadable trace-event JSON."""
+    trace_out = getattr(args, "trace_out", "")
+    if trace_out:
+        from .obs import get_tracer
+        os.makedirs(trace_out, exist_ok=True)
+        get_tracer().export_dir = trace_out
+    return trace_out
 
 
 def _finish_many(args, results) -> int:

@@ -29,6 +29,7 @@ import random
 import threading
 import time
 
+from ..obs.trace import add_event
 from ..utils import get_logger
 from .spec import FaultSpec, parse_fault_spec
 
@@ -113,6 +114,10 @@ class FaultInjector:
                 or self._hit(spec.cache_fail_rate))
         if fail:
             self._inc("cache_faults")
+            # fault injections land on the active request's span so
+            # traces show what was injected (device-site faults are
+            # recorded by the scheduler's dispatch spans instead)
+            add_event("fault_injected", site="cache", op=op)
             raise CacheFault(
                 f"injected cache outage ({op} {key!r}, op #{n})")
 
@@ -122,6 +127,8 @@ class FaultInjector:
         self._inc("image_loads")
         if any(m in (name or "") for m in self.spec.corrupt):
             self._inc("corrupt_faults")
+            add_event("fault_injected", site="host",
+                      kind="corrupt-layer", target=name)
             raise CorruptLayerFault(
                 f"injected corrupt layer tar in {name!r}")
 
@@ -129,6 +136,8 @@ class FaultInjector:
         spec = self.spec
         if spec.stall_s > 0 and self._hit(spec.stall_rate):
             self._inc("stalls")
+            add_event("fault_injected", site="host", kind="stall",
+                      seconds=spec.stall_s)
             time.sleep(spec.stall_s)
 
     # --- device site ---

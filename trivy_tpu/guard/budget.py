@@ -213,6 +213,11 @@ class ResourceBudget:
             self.metrics.inc("deadline_trips")
         self.metrics.inc("budget_trips")
         prefix = f"{self.name}: " if self.name else ""
+        # trips land on the active span (the request's analyze
+        # phase) so the trace shows WHY the slot degraded/failed
+        from ..obs.trace import add_event
+        add_event("guard_trip", kind=exc_cls.kind,
+                  message=prefix + msg)
         raise exc_cls(prefix + msg)
 
     def malformed(self, msg: str) -> None:
@@ -227,6 +232,8 @@ class ResourceBudget:
         with self._lock:
             self.soft_faults.append((kind, message))
         self.metrics.inc("soft_faults")
+        from ..obs.trace import add_event
+        add_event("ingest_soft_fault", kind=kind, message=message)
 
     # --- checks (called from the safetar/walker hot loops) ---
 

@@ -1,0 +1,21 @@
+"""Observability: per-request distributed tracing, the flight
+recorder, and Prometheus text exposition (docs/observability.md).
+
+Zero-dependency by design — spans, the ring, and the exposition
+renderer are stdlib-only, so the tracing layer can thread through
+the RPC client, the scheduler and the artifact seams without adding
+imports the hot path pays for.
+"""
+
+from .prom import render_prometheus
+from .recorder import FlightRecorder, RingLogHandler
+from .trace import (NOOP_SPAN, Span, Tracer, add_event, current_span,
+                    get_tracer, new_trace_id, summarize, to_chrome,
+                    trace_cause)
+
+__all__ = [
+    "FlightRecorder", "NOOP_SPAN", "RingLogHandler", "Span",
+    "Tracer", "add_event", "current_span", "get_tracer",
+    "new_trace_id", "render_prometheus", "summarize", "to_chrome",
+    "trace_cause",
+]

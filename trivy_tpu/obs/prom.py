@@ -1,0 +1,210 @@
+"""Prometheus text exposition (format version 0.0.4) for
+``GET /metrics`` (docs/observability.md).
+
+The JSON snapshot stays the default; a scrape that sends
+``Accept: text/plain`` gets this rendering instead. The input is the
+same nested dict ``ScanServer.metrics()`` serves as JSON — rendering
+is tolerant of missing sections (a scheduler-off server still
+exposes guard/admission/idempotency metrics).
+
+Histograms use the raw bucket counts (``SchedMetrics.hist_snapshot``
+and ``Tracer.phase_snapshot`` both emit ``{"bounds", "counts",
+"sum", "count"}``), exposed cumulatively with the mandatory
+``+Inf`` bucket, ``_sum`` and ``_count`` series.
+"""
+
+from __future__ import annotations
+
+_PREFIX = "trivy_tpu"
+
+_BREAKER_STATES = ("closed", "open", "half-open")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
+        return repr(v)
+    return str(v)
+
+
+def _esc(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: list = []
+
+    def header(self, name: str, mtype: str, help_: str) -> None:
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+
+    def sample(self, name: str, labels, value) -> None:
+        if value is None:
+            return
+        if labels:
+            lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels)
+            self.lines.append(f"{name}{{{lab}}} {_fmt(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt(value)}")
+
+    def scalar(self, name: str, mtype: str, help_: str,
+               value) -> None:
+        if value is None:
+            return
+        self.header(name, mtype, help_)
+        self.sample(name, None, value)
+
+
+def _histograms(w: _Writer, name: str, label: str, hists: dict,
+                help_: str) -> None:
+    if not hists:
+        return
+    full = f"{_PREFIX}_{name}_seconds"
+    w.header(full, "histogram", help_)
+    for key in sorted(hists):
+        h = hists[key]
+        bounds, counts = h["bounds"], h["counts"]
+        cum = 0
+        for b, c in zip(bounds, counts):
+            cum += c
+            w.sample(full + "_bucket",
+                     [(label, key), ("le", _fmt(float(b)))], cum)
+        cum += counts[len(bounds)] if len(counts) > len(bounds) else 0
+        w.sample(full + "_bucket", [(label, key), ("le", "+Inf")],
+                 cum)
+        w.sample(full + "_sum", [(label, key)], float(h["sum"]))
+        w.sample(full + "_count", [(label, key)], h["count"])
+
+
+def render_prometheus(stats: dict, phase_hists=None,
+                      trace_hists=None, tracer_stats=None,
+                      recorder_stats=None) -> str:
+    """Render the ``/metrics`` snapshot dict as Prometheus text."""
+    w = _Writer()
+
+    counters = stats.get("counters") or {}
+    if counters:
+        name = f"{_PREFIX}_sched_events_total"
+        w.header(name, "counter",
+                 "Scheduler request lifecycle events by kind.")
+        for k in sorted(counters):
+            w.sample(name, [("event", k)], counters[k])
+
+    w.scalar(f"{_PREFIX}_sched_queue_depth", "gauge",
+             "Admission queue depth.", stats.get("queue_depth"))
+    w.scalar(f"{_PREFIX}_sched_queue_depth_max", "gauge",
+             "High-water admission queue depth.",
+             stats.get("queue_depth_max"))
+    if "draining" in stats:
+        w.scalar(f"{_PREFIX}_draining", "gauge",
+                 "1 while the server refuses new work.",
+                 1 if stats.get("draining") else 0)
+
+    batch = stats.get("batch") or {}
+    if batch:
+        w.scalar(f"{_PREFIX}_sched_batches_total", "counter",
+                 "Coalesced device batches dispatched.",
+                 batch.get("count"))
+        w.scalar(f"{_PREFIX}_sched_batch_items_total", "counter",
+                 "Requests carried by dispatched batches.",
+                 batch.get("items_total"))
+        w.scalar(f"{_PREFIX}_sched_batch_candidate_bytes_total",
+                 "counter", "Candidate bytes across batches.",
+                 batch.get("candidate_bytes"))
+        w.scalar(f"{_PREFIX}_sched_batch_occupancy", "gauge",
+                 "Mean bucket occupancy (1 - padding waste).",
+                 batch.get("occupancy"))
+        w.scalar(f"{_PREFIX}_sched_batch_padding_waste", "gauge",
+                 "Mean padding waste across batches.",
+                 batch.get("padding_waste"))
+
+    for key, help_ in (("host_busy_s",
+                        "Cumulative host worker busy seconds."),
+                       ("device_busy_s",
+                        "Cumulative device busy seconds."),
+                       ("overlap_s",
+                        "Seconds host and device were busy "
+                        "simultaneously.")):
+        w.scalar(f"{_PREFIX}_sched_{key[:-2]}_seconds_total",
+                 "counter", help_, stats.get(key))
+    w.scalar(f"{_PREFIX}_sched_overlap_ratio", "gauge",
+             "overlap_s / device_busy_s.",
+             stats.get("overlap_ratio"))
+    w.scalar(f"{_PREFIX}_uptime_seconds", "gauge",
+             "Scheduler uptime.", stats.get("uptime_s"))
+
+    guard = stats.get("guard") or {}
+    if guard:
+        name = f"{_PREFIX}_guard_events_total"
+        w.header(name, "counter",
+                 "Ingest-guard counters (budget trips, malformed "
+                 "archives, walked entries, ...).")
+        for k in sorted(guard):
+            w.sample(name, [("event", k)], guard[k])
+
+    idem = stats.get("idempotency") or {}
+    if idem:
+        w.scalar(f"{_PREFIX}_idempotency_entries", "gauge",
+                 "Live idempotency-window entries.",
+                 idem.get("entries"))
+        w.scalar(f"{_PREFIX}_idempotency_hits_total", "counter",
+                 "Duplicate Scan RPCs served from the window.",
+                 idem.get("hits"))
+
+    adm = stats.get("admission") or {}
+    if adm:
+        w.scalar(f"{_PREFIX}_admission_max_body_bytes", "gauge",
+                 "413 admission cap on request body size.",
+                 adm.get("max_body_bytes"))
+        w.scalar(f"{_PREFIX}_admission_max_scan_blobs", "gauge",
+                 "413 admission cap on blobs per Scan.",
+                 adm.get("max_scan_blobs"))
+
+    breaker = (stats.get("cache_breaker") or {}).get("breaker") or {}
+    if breaker:
+        name = f"{_PREFIX}_cache_breaker_state"
+        w.header(name, "gauge",
+                 "Cache circuit-breaker state (1 = current).")
+        state = breaker.get("state", "closed")
+        for s in _BREAKER_STATES:
+            w.sample(name, [("state", s)], 1 if s == state else 0)
+        w.scalar(f"{_PREFIX}_cache_breaker_trips_total", "counter",
+                 "Circuit-breaker trips.", breaker.get("trips"))
+        w.scalar(f"{_PREFIX}_cache_fallback_ops_total", "counter",
+                 "Cache ops answered by the local fallback.",
+                 (stats.get("cache_breaker") or {})
+                 .get("fallback_ops"))
+
+    if tracer_stats:
+        w.scalar(f"{_PREFIX}_trace_spans_total", "counter",
+                 "Spans recorded by the tracer.",
+                 tracer_stats.get("spans"))
+        w.scalar(f"{_PREFIX}_trace_traces_total", "counter",
+                 "Completed traces.", tracer_stats.get("traces"))
+    if recorder_stats:
+        w.scalar(f"{_PREFIX}_flight_recorder_traces", "gauge",
+                 "Traces held in the flight-recorder ring.",
+                 recorder_stats.get("traces"))
+        w.scalar(f"{_PREFIX}_flight_recorder_evicted_total",
+                 "counter", "Traces evicted from the ring.",
+                 recorder_stats.get("evicted"))
+        w.scalar(f"{_PREFIX}_flight_recorder_dumps_total",
+                 "counter", "Crash-dump traces written to disk.",
+                 recorder_stats.get("dumps"))
+
+    _histograms(w, "sched_phase_latency", "phase", phase_hists or {},
+                "Scheduler per-phase latency (queue_wait, analyze, "
+                "device, finish, request).")
+    _histograms(w, "trace_span", "span", trace_hists or {},
+                "Per-phase latency derived from trace spans.")
+
+    return "\n".join(w.lines) + "\n"

@@ -47,6 +47,9 @@ class _Client:
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
         self.timeout_s = timeout_s
+        # trace_id of the most recent Scan call (RemoteScanner):
+        # lets a CLI client surface "see /trace/<id> on the server"
+        self.last_trace_id = ""
 
     def call(self, path: str, body: dict) -> dict:
         """POST with exponential-backoff retry on transient errors
@@ -131,10 +134,21 @@ class RemoteScanner(_Client):
         retry attempts of THIS call: if a response is lost after the
         server enqueued the scan, the retry replays the first
         enqueue's outcome instead of double-enqueuing into the
-        scheduler."""
+        scheduler.
+
+        It also carries a client-generated ``trace_id`` (Dapper-style
+        propagation, docs/observability.md): the server roots this
+        request's span tree under it, so the caller can pull the
+        trace from ``GET /trace/<id>`` — the id is logged at debug
+        and kept on ``self.last_trace_id``. Retries reuse the same
+        id: they are attempts at ONE logical request."""
         import uuid
+        self.last_trace_id = uuid.uuid4().hex
+        log.debug("scan %r trace_id=%s", target.name,
+                  self.last_trace_id)
         out = self.call(SCANNER_PREFIX + "Scan", {
             "idempotency_key": uuid.uuid4().hex,
+            "trace_id": self.last_trace_id,
             "target": target.name,
             "artifact_id": target.artifact_id,
             "blob_ids": list(target.blob_ids),

@@ -128,7 +128,8 @@ class ScanServer:
                  token_header: str = DEFAULT_TOKEN_HEADER,
                  sched: str = "off", sched_config=None,
                  max_body_bytes: int = MAX_BODY_BYTES,
-                 max_scan_blobs: int = MAX_SCAN_BLOBS):
+                 max_scan_blobs: int = MAX_SCAN_BLOBS,
+                 tracer=None):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -155,8 +156,20 @@ class ScanServer:
             cfg = sched_config
             if isinstance(sched, SchedConfig):
                 cfg = sched
-            self.scheduler = ScanScheduler(config=cfg)
+            self.scheduler = ScanScheduler(config=cfg,
+                                           tracer=tracer)
             self._owns_scheduler = True
+        # tracer (docs/observability.md): Scan RPCs propagate the
+        # client's trace_id into per-request span trees, served back
+        # at GET /trace/<id>; a shared scheduler's tracer wins so
+        # both request sources land in one flight recorder
+        if tracer is None:
+            if self.scheduler is not None:
+                tracer = self.scheduler.tracer
+            else:
+                from ..obs.trace import get_tracer
+                tracer = get_tracer()
+        self.tracer = tracer
 
     def close(self) -> None:
         # only tear down a scheduler this server constructed — an
@@ -256,12 +269,19 @@ class ScanServer:
         # readers hold the store across the whole scan; swap waits
         # for them to drain (SwappableStore), like the server's
         # dbUpdateWg/requestWg pair
+        root = self.tracer.start_request(
+            target.name, trace_id=str(body.get("trace_id") or ""))
         db = self.store.acquire()
         try:
-            scanner = LocalScanner(self.cache, db)
-            results, os_found = scanner.scan(target, options)
+            with root.activate():
+                scanner = LocalScanner(self.cache, db)
+                results, os_found = scanner.scan(target, options)
+        except BaseException:
+            root.end("failed")
+            raise
         finally:
             self.store.release()
+        root.end()
         return {
             "os": os_found.to_dict() if os_found else None,
             "results": [r.to_dict() for r in results],
@@ -295,7 +315,11 @@ class ScanServer:
             name=target.name, analyze=analyze,
             deadline_s=float(body.get("deadline_s") or 0.0),
             group=options.backend,
-            on_done=lambda _req: self.store.release())
+            on_done=lambda _req: self.store.release(),
+            # the client's trace_id rides the body; the scheduler's
+            # tracer validates it (hex only — it becomes a dump file
+            # name) and roots this request's span tree under it
+            trace_id=str(body.get("trace_id") or "")[:64])
         try:
             self.scheduler.submit(req)
         except BaseException:
@@ -320,7 +344,27 @@ class ScanServer:
         breaker = getattr(self.cache, "breaker_stats", None)
         if callable(breaker):
             out["cache_breaker"] = breaker()
+        out["trace"] = dict(self.tracer.stats(),
+                            recorder=self.tracer.recorder.stats())
         return out
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the same snapshot — served
+        when a /metrics scrape sends ``Accept: text/plain``
+        (docs/observability.md has a scrape config)."""
+        from ..obs.prom import render_prometheus
+        phase = self.scheduler.metrics.hist_snapshot() \
+            if self.scheduler is not None else None
+        return render_prometheus(
+            self.metrics(), phase_hists=phase,
+            trace_hists=self.tracer.phase_snapshot(),
+            tracer_stats=self.tracer.stats(),
+            recorder_stats=self.tracer.recorder.stats())
+
+    def trace(self, trace_id: str):
+        """Chrome trace-event JSON for ``GET /trace/<id>``, or None
+        when the id is unknown (or already evicted from the ring)."""
+        return self.tracer.trace(trace_id)
 
     # ---- dispatch ----
 
@@ -396,12 +440,28 @@ def _make_handler(server: ScanServer):
             log.debug("http: " + fmt, *args)
 
         def _reply(self, code: int, payload: dict) -> None:
-            data = json.dumps(payload).encode()
+            self._reply_text(code, json.dumps(payload),
+                             "application/json")
+
+        def _reply_text(self, code: int, text: str,
+                        ctype: str) -> None:
+            data = text.encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+
+        def _authorized(self) -> bool:
+            if not server.token:
+                return True
+            import hmac
+            got = self.headers.get(server.token_header) or ""
+            if hmac.compare_digest(got, server.token):
+                return True
+            self._reply(401, {"code": "unauthenticated",
+                              "msg": "invalid token"})
+            return False
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -409,26 +469,36 @@ def _make_handler(server: ScanServer):
             elif self.path == "/metrics":
                 # /healthz stays open (probes), but the operational
                 # detail in /metrics honors the server token
-                if server.token:
-                    import hmac
-                    got = self.headers.get(server.token_header) or ""
-                    if not hmac.compare_digest(got, server.token):
-                        self._reply(401, {"code": "unauthenticated",
-                                          "msg": "invalid token"})
-                        return
-                self._reply(200, server.metrics())
+                if not self._authorized():
+                    return
+                # content negotiation: a Prometheus scrape sends
+                # Accept: text/plain and gets the text exposition;
+                # everything else keeps the JSON snapshot
+                accept = self.headers.get("Accept") or ""
+                if "text/plain" in accept or "openmetrics" in accept:
+                    self._reply_text(
+                        200, server.metrics_text(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._reply(200, server.metrics())
+            elif self.path.startswith("/trace/"):
+                # per-request trace lookup (docs/observability.md):
+                # operational detail, so it honors the token too
+                if not self._authorized():
+                    return
+                doc = server.trace(self.path[len("/trace/"):])
+                if doc is None:
+                    self._reply(404, {"code": "not_found",
+                                      "msg": self.path})
+                else:
+                    self._reply(200, doc)
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
 
         def do_POST(self):
-            if server.token:
-                import hmac
-                got = self.headers.get(server.token_header) or ""
-                if not hmac.compare_digest(got, server.token):
-                    self._reply(401, {"code": "unauthenticated",
-                                      "msg": "invalid token"})
-                    return
+            if not self._authorized():
+                return
             inj = server.fault_injector
             action = inj.rpc_action(self.path) if inj is not None \
                 else "ok"

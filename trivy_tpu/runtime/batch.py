@@ -75,11 +75,16 @@ class BatchScanRunner:
                  cache=None, backend: str = "tpu", mesh=None,
                  secret_scanner=None, sched="off",
                  sched_config=None, artifact_option=None,
-                 fault_injector=None):
+                 fault_injector=None, tracer=None):
+        from ..obs.trace import get_tracer
         self.store = store or AdvisoryStore()
         self.cache = cache if cache is not None else MemoryCache()
         self.backend = backend
         self.mesh = mesh
+        # tracer: trivy_tpu.obs.Tracer — per-request span trees on
+        # both execution paths (docs/observability.md); the bench's
+        # differential arm passes Tracer(enabled=False)
+        self.tracer = tracer if tracer is not None else get_tracer()
         if secret_scanner is None:
             from ..secret.batch import BatchSecretScanner
             secret_scanner = BatchSecretScanner(
@@ -117,7 +122,8 @@ class BatchScanRunner:
             from ..sched import ScanScheduler
             self._scheduler = ScanScheduler(
                 config=self.sched_config, backend=self.backend,
-                mesh=self.mesh, secret_scanner=self.secret_scanner)
+                mesh=self.mesh, secret_scanner=self.secret_scanner,
+                tracer=self.tracer)
             self._scheduler.fault_injector = self.fault_injector
             self._owns_scheduler = True
         return self._scheduler
@@ -211,7 +217,9 @@ class BatchScanRunner:
             except Exception as e:       # noqa: BLE001 — one slot's
                 # failure (typed or not) must never crash the fleet
                 # gather; the cause lands in the slot's report
-                out.append(_failed_slot(name, e))
+                out.append(_failed_slot(name, e,
+                                        trace_id=req.trace_id,
+                                        tracer=self.tracer))
         self.last_stats = {"images": len(items),
                            "sched": sched.stats()}
         for k, v in self.last_stats["sched"].items():
@@ -320,23 +328,43 @@ class BatchScanRunner:
         scan_secrets = "secret" in options.security_checks
 
         # ---- phase 1: analyze missing layers, collect candidates ----
+        # tracing (docs/observability.md): the direct path has no
+        # queue, so each image's span tree is analyze → device (the
+        # fleet-shared dispatch window) → report
+        tracer = self.tracer
         t0 = _time.perf_counter()
         slots, failures = [], {}     # [(input idx, artifact)]
+        roots: dict = {}             # input idx -> root span
         opt = self._image_opt(scan_secrets)
         for idx, img in enumerate(images):
+            name = getattr(img, "name", "")
+            root = tracer.start_request(name)
+            roots[idx] = root
             a = _CollectingImageArtifact(img, self.cache, opt)
+            sp = tracer.child(root, "analyze")
             try:
-                a.reference = a.inspect()
+                with sp.activate():
+                    a.reference = a.inspect()
             except Exception as e:   # noqa: BLE001 — a hostile or
                 # broken artifact fails ITS slot with a typed cause;
                 # the fleet keeps scanning (same isolation the
                 # scheduled path gets from per-request analyze)
+                sp.end("error")
+                root.set("error", repr(e))
+                root.end("failed")
                 failures[idx] = _failed_slot(
-                    getattr(img, "name", ""), e)
+                    name, e, trace_id=root.trace_id, tracer=tracer)
                 continue
+            sp.end()
             slots.append((idx, a))
         artifacts = [a for _, a in slots]
         analyze_s = _time.perf_counter() - t0
+        # one shared device window per surviving image: the sieve
+        # and interval dispatches below serve the whole fleet, so
+        # every slot's "device" span brackets the same wall interval
+        dev_spans = {idx: tracer.child(roots[idx], "device",
+                                       shared=True)
+                     for idx, _ in slots}
 
         # ---- phase 2a: ENQUEUE the sieve dispatch (async) ----
         # the device sieves while the host squashes + preps interval
@@ -397,6 +425,8 @@ class BatchScanRunner:
                              for b in a.reference.blob_ids]
                     p.detail.secrets = merge_layer_secrets(blobs)
         secret_s += _time.perf_counter() - t0
+        for sp in dev_spans.values():
+            sp.end()
 
         from ..detect import batch as detect_batch
         self.last_stats = {
@@ -415,28 +445,39 @@ class BatchScanRunner:
         # ---- phase 5: assemble per image ----
         out = dict(failures)
         for local, ((idx, a), p) in enumerate(zip(slots, prepared)):
-            results, os_found = scanner.finish(
-                p, detected_by_image.get(local, []))
-            ref = a.reference
-            res = BatchScanResult(
-                name=ref.name,
-                report=Report(
-                    artifact_name=ref.name,
-                    artifact_type="container_image",
-                    metadata=Metadata(
-                        os=os_found,
-                        image_id=ref.image_metadata.id,
-                        diff_ids=ref.image_metadata.diff_ids,
-                        repo_tags=ref.image_metadata.repo_tags,
-                        image_config=ref.image_metadata.image_config,
-                    ),
-                    results=results,
-                ))
+            sp = tracer.child(roots[idx], "report")
+            with sp.activate():
+                results, os_found = scanner.finish(
+                    p, detected_by_image.get(local, []))
+                ref = a.reference
+                res = BatchScanResult(
+                    name=ref.name,
+                    report=Report(
+                        artifact_name=ref.name,
+                        artifact_type="container_image",
+                        metadata=Metadata(
+                            os=os_found,
+                            image_id=ref.image_metadata.id,
+                            diff_ids=ref.image_metadata.diff_ids,
+                            repo_tags=ref.image_metadata.repo_tags,
+                            image_config=ref.image_metadata
+                            .image_config,
+                        ),
+                        results=results,
+                    ))
+            sp.end()
+            root = roots[idx]
             b = getattr(a, "budget", None)
-            if b is not None and b.soft_faults:
-                res.apply_degraded(
-                    [{"stage": "ingest", "kind": k, "message": m}
-                     for k, m in b.soft_faults])
+            degraded = b is not None and b.soft_faults
+            if degraded:
+                causes = [{"stage": "ingest", "kind": k,
+                           "message": m} for k, m in b.soft_faults]
+                if not root.noop:
+                    from ..obs.trace import trace_cause
+                    causes.append(trace_cause(tracer,
+                                              root.trace_id))
+                res.apply_degraded(causes)
+            root.end("degraded" if degraded else "ok")
             out[idx] = res
         return [out[i] for i in range(len(images))]
 
@@ -609,11 +650,14 @@ class _SchedImageArtifact(_CollectingImageArtifact):
         return super()._batch_secrets(candidates)
 
 
-def _failed_slot(name: str, err: BaseException) -> BatchScanResult:
+def _failed_slot(name: str, err: BaseException, trace_id: str = "",
+                 tracer=None) -> BatchScanResult:
     """One failed fleet slot with a machine-readable cause: the
     typed scheduler errors map to distinct kinds so a caller can
     tell backpressure (retryable) from deadline (not) from a broken
-    image."""
+    image. When the slot was traced, a trailing ``obs/trace`` cause
+    references the flight-recorder dump (the primary cause stays
+    first — callers key off ``causes[0]``)."""
     import tarfile as _tarfile
 
     from ..guard.budget import GuardError
@@ -635,8 +679,14 @@ def _failed_slot(name: str, err: BaseException) -> BatchScanResult:
         stage, kind = "host", "load_failed"
     else:
         stage, kind = "sched", "error"
-    return BatchScanResult(name=name, error=str(err)).mark_failed(
+    res = BatchScanResult(name=name, error=str(err)).mark_failed(
         stage, kind, str(err))
+    if trace_id and tracer is not None:
+        from ..obs.trace import trace_cause
+        from ..types.report import FailureCause
+        res.causes.append(
+            FailureCause.coerce(trace_cause(tracer, trace_id)))
+    return res
 
 
 def _make_patch(cache, artifact):

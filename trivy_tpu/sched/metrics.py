@@ -20,14 +20,21 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 
 
 class LatencyHistogram:
     """Fixed-bound latency histogram (seconds) with quantile
-    estimates by linear interpolation inside the winning bucket."""
+    estimates by linear interpolation inside the winning bucket.
 
-    BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-              0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+    Bucket search is a bisect over ``BOUNDS`` (O(log n), not the
+    linear scan the observe hot path used to pay), and the ladder
+    starts at 100µs/250µs/500µs so device-phase latencies spread
+    over real buckets instead of collapsing into the first one."""
+
+    BOUNDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+              0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+              30.0, 60.0)
 
     def __init__(self):
         self.counts = [0] * (len(self.BOUNDS) + 1)
@@ -36,13 +43,10 @@ class LatencyHistogram:
         self.max = 0.0
 
     def observe(self, v: float) -> None:
-        i = 0
-        for i, b in enumerate(self.BOUNDS):
-            if v <= b:
-                break
-        else:
-            i = len(self.BOUNDS)
-        self.counts[i] += 1
+        # bisect_left finds the first bound >= v, i.e. the same
+        # bucket the old `v <= b` scan chose; values past the last
+        # bound land in the overflow slot
+        self.counts[bisect_left(self.BOUNDS, v)] += 1
         self.total += 1
         self.sum += v
         if v > self.max:
@@ -183,7 +187,24 @@ class SchedMetrics:
 
     # --- snapshot ---
 
+    def hist_snapshot(self) -> dict:
+        """Raw bucket counts per phase for Prometheus exposition
+        (trivy_tpu/obs/prom.py) — the JSON snapshot only carries the
+        derived quantiles."""
+        with self._lock:
+            return {p: {"bounds": list(h.BOUNDS),
+                        "counts": list(h.counts),
+                        "sum": h.sum, "count": h.total}
+                    for p, h in self.hist.items()}
+
     def snapshot(self) -> dict:
+        # the live queue-depth gauge is called OUTSIDE self._lock:
+        # it takes the scheduler queue's lock, so calling it under
+        # the (non-reentrant) metrics lock imposes a metrics→queue
+        # lock order on every gauge implementation — and deadlocks
+        # outright on a gauge that consults the metrics
+        depth_fn = self._depth_fn
+        depth = depth_fn() if depth_fn else 0
         with self._lock:
             now = time.monotonic()
             overlap = self._overlap_s
@@ -198,8 +219,7 @@ class SchedMetrics:
             padding_waste = 1.0 - occupancy if batches else 0.0
             out = {
                 "counters": dict(self.counters),
-                "queue_depth": (self._depth_fn()
-                                if self._depth_fn else 0),
+                "queue_depth": depth,
                 "queue_depth_max": self._depth_max,
                 "batch": {
                     "count": batches,

@@ -76,10 +76,18 @@ class ScanRequest:
 
     def __init__(self, name: str, analyze: Callable,
                  deadline_s: float = 0.0, group: str = "",
-                 on_done: Optional[Callable] = None):
+                 on_done: Optional[Callable] = None,
+                 trace_id: str = ""):
         self.name = name
         self.analyze = analyze
         self.group = group
+        # tracing (trivy_tpu/obs): an incoming trace_id (RPC clients
+        # propagate theirs) is honored by the scheduler's tracer,
+        # which fills these span slots at each stage boundary
+        self.trace_id = trace_id
+        self.span_root = None
+        self.span_queue = None
+        self.span_coalesce = None
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + deadline_s
                          if deadline_s and deadline_s > 0 else None)

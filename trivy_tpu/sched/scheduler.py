@@ -37,6 +37,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from ..obs.trace import get_tracer, trace_cause
 from ..utils import get_logger
 from .coalescer import Batch, Coalescer, SchedConfig
 from .metrics import SchedMetrics
@@ -67,7 +68,7 @@ class ScanScheduler:
 
     def __init__(self, config: Optional[SchedConfig] = None,
                  backend: str = "tpu", mesh=None,
-                 secret_scanner=None):
+                 secret_scanner=None, tracer=None):
         self.config = config or SchedConfig()
         self.backend = backend
         self.mesh = mesh
@@ -76,6 +77,9 @@ class ScanScheduler:
         # consulted at the top of every device dispatch so injected
         # device failures exercise the bisect/quarantine machinery
         self.fault_injector = None
+        # tracer: trivy_tpu.obs.Tracer — every admitted request gets
+        # a root span with per-stage children (docs/observability.md)
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.metrics = SchedMetrics()
         self.queue = AdmissionQueue(self.config.max_queue)
         self.metrics.set_depth_gauge(self.queue.depth)
@@ -87,6 +91,7 @@ class ScanScheduler:
         self._kernel_s = 0.0      # interval-kernel wall (all batches)
         self._running = False
         self._draining = False
+        self._batch_seq = 0       # device-thread only (batch ids)
         self._lock = threading.Lock()
         # blob id → patch event of the request that will write it
         self._blob_lock = threading.Lock()
@@ -187,10 +192,25 @@ class ScanScheduler:
             request.deadline = (request.submitted_at +
                                 self.config.default_deadline_s)
         request.group = request.group or self.backend
+        root = self.tracer.start_request(request.name,
+                                         trace_id=request.trace_id)
+        request.trace_id = root.trace_id
+        request.span_root = root
+        request.span_queue = self.tracer.child(root, "queue_wait")
         try:
             self.queue.put(request, block=block)
         except QueueFullError:
             self.metrics.inc("rejected")
+            # "rejected", not "failed": a backpressure 503 carries no
+            # diagnostic value, and the tracer only crash-dumps
+            # degraded/failed traces — a rejection storm must never
+            # become a disk-write storm
+            request.span_queue.end("error")
+            root.end("rejected")
+            raise
+        except SchedulerClosed:
+            request.span_queue.end("error")
+            root.end("rejected")
             raise
         self.metrics.inc("submitted")
         self.metrics.note_depth(self.queue.depth())
@@ -248,12 +268,33 @@ class ScanScheduler:
 
     # --- resolution helpers ---
 
+    def _end_trace(self, req: ScanRequest, status: str,
+                   err=None) -> None:
+        """Close the request's span tree: any stage span still open
+        (a failure can resolve the request mid-stage), then the
+        root — which completes the trace (flight-recorder ring,
+        export, degraded-dump) in the tracer."""
+        root = req.span_root
+        if root is None or root.noop:
+            return
+        for name in ("span_queue", "span_coalesce"):
+            sp = getattr(req, name, None)
+            if sp is not None:
+                sp.end("error" if status == "failed" else None)
+        if err is not None:
+            root.set("error", repr(err))
+        if req.faults:
+            root.set("faults", len(req.faults))
+        root.end(status)
+
     def _complete(self, req: ScanRequest, result) -> None:
         self._clear_blob_writes(req)
         if req.set_result(result):
             self.metrics.inc("completed")
             self.metrics.observe(
                 "request", time.monotonic() - req.submitted_at)
+            self._end_trace(req,
+                            "degraded" if req.faults else "ok")
 
     def _fail(self, req: ScanRequest, err: BaseException) -> None:
         self._clear_blob_writes(req)
@@ -264,6 +305,7 @@ class ScanScheduler:
                 self.metrics.inc("cancelled")
             else:
                 self.metrics.inc("failed")
+            self._end_trace(req, "failed", err)
 
     def _sweep(self, req: ScanRequest) -> bool:
         """True if the request is dead (expired/cancelled) and was
@@ -296,6 +338,8 @@ class ScanScheduler:
             req = self.queue.get(timeout=0.05)
             if req is None:
                 continue
+            if req.span_queue is not None:
+                req.span_queue.end()
             self.metrics.observe(
                 "queue_wait", time.monotonic() - req.submitted_at)
             if self._sweep(req):
@@ -311,12 +355,23 @@ class ScanScheduler:
 
     def _analyze(self, req: ScanRequest) -> None:
         t0 = self.metrics.host_begin()
+        sp = self.tracer.child(req.span_root, "analyze")
         try:
             if not self._sweep(req):
-                req.work = req.analyze(req)
+                with sp.activate():
+                    req.work = req.analyze(req)
                 req.work.group = req.work.group or req.group
+                sp.end()
+                # the coalesce span opens BEFORE the request is
+                # published to the device thread, which closes it
+                # when the batch flushes
+                req.span_coalesce = self.tracer.child(
+                    req.span_root, "coalesce")
                 self.coalescer.add(req)
+            else:
+                sp.end("error")
         except Exception as e:       # noqa: BLE001
+            sp.end("error")
             log.warning("analyze %r failed: %r", req.name, e)
             self._fail(req, e)
         finally:
@@ -361,8 +416,22 @@ class ScanScheduler:
             len(reqs), batch.candidate_bytes, batch.jobs,
             batch.bucket_bytes, batch.bucket_jobs)
 
-        results = self._dispatch_isolated(reqs,
-                                          batch.group or self.backend)
+        self._batch_seq += 1
+        bid = self._batch_seq
+        occ = round(batch.occupancy, 4)
+        for r in reqs:
+            sp = r.span_coalesce
+            if sp is not None:
+                if not sp.noop:
+                    sp.set("batch", bid)
+                    sp.set("items", len(reqs))
+                    sp.set("bucket_bytes", batch.bucket_bytes)
+                    sp.set("bucket_jobs", batch.bucket_jobs)
+                    sp.set("occupancy", occ)
+                sp.end()
+
+        results = self._dispatch_isolated(
+            reqs, batch.group or self.backend, batch_id=bid)
 
         # patch + event-set happen HERE, on the device thread, so
         # every patch event is resolved without touching the worker
@@ -394,108 +463,155 @@ class ScanScheduler:
 
     # --- poison-image isolation (docs/robustness.md) ---
 
-    def _dispatch(self, reqs: list, group: str) -> dict:
+    def _dispatch(self, reqs: list, group: str, depth: int = 0,
+                  batch_id: int = 0,
+                  attempt: str = "batch") -> dict:
         """One coalesced device dispatch over ``reqs`` →
         ``{id(req): (sieve_found, detected)}``. Raises on device
-        failure — isolation happens in _dispatch_isolated."""
+        failure — isolation happens in _dispatch_isolated. Every
+        request gets a ``device`` span per attempt, so bisect halves
+        and quarantine retries appear as sibling spans in the
+        trace."""
         from ..detect.batch import dispatch_jobs
 
-        if self.fault_injector is not None:
-            self.fault_injector.on_device_dispatch(
-                [r.name for r in reqs])
-
-        # flatten sieve candidates; owner map brings results home by
-        # ENTRY INDEX (paths repeat across images — see secret.batch)
-        files, owner, local = [], [], []
-        for i, r in enumerate(reqs):
-            for j, (path, content) in enumerate(r.work.candidates):
-                files.append((path, content))
-                owner.append(i)
-                local.append(j)
-
-        # payloads are tagged with the request's batch index for the
-        # duration of the dispatch and restored after — a bisect
-        # retry re-tags against ITS OWN indices, so a failed dispatch
-        # must never leave its wrapping behind
-        wrapped = []
-        for i, r in enumerate(reqs):
-            for job in r.work.jobs:
-                wrapped.append((job, job.payload))
-                job.payload = (i, job.payload)
-
-        t0 = self.metrics.device_begin()
+        spans = []
+        for r in reqs:
+            sp = self.tracer.child(r.span_root, "device")
+            if not sp.noop:
+                sp.set("batch", batch_id)
+                sp.set("requests", len(reqs))
+                if depth:
+                    sp.set("bisect_depth", depth)
+                if attempt != "batch":
+                    sp.set("attempt", attempt)
+            spans.append(sp)
         try:
-            sieve_handle = None
-            if files and self.secret_scanner is not None:
-                # async enqueue: the device sieves while the interval
-                # dispatch below compiles/queues behind it
-                sieve_handle = self.secret_scanner.dispatch_files(
-                    files)
+            if self.fault_injector is not None:
+                self.fault_injector.on_device_dispatch(
+                    [r.name for r in reqs])
 
-            all_jobs = [job for job, _ in wrapped]
-            detected_by: dict = {}
-            if all_jobs:
-                kstats: dict = {}    # per-batch sink, not the global
-                for i, payload in dispatch_jobs(
-                        all_jobs, backend=group,
-                        mesh=self.mesh, stats=kstats):
-                    detected_by.setdefault(i, []).append(payload)
-                with self._lock:
-                    self._kernel_s += kstats.get("device_s", 0.0)
+            # flatten sieve candidates; owner map brings results
+            # home by ENTRY INDEX (paths repeat across images — see
+            # secret.batch)
+            files, owner, local = [], [], []
+            for i, r in enumerate(reqs):
+                for j, (path, content) in enumerate(
+                        r.work.candidates):
+                    files.append((path, content))
+                    owner.append(i)
+                    local.append(j)
 
-            found_by: dict = {}
-            if sieve_handle is not None:
-                for idx, secret in self.secret_scanner.collect(
-                        sieve_handle):
-                    found_by.setdefault(owner[idx], []).append(
-                        (local[idx], secret))
-        finally:
-            for job, orig in wrapped:
-                job.payload = orig
-            self.metrics.device_end(t0)
-        self.metrics.observe("device", time.monotonic() - t0)
+            # payloads are tagged with the request's batch index for
+            # the duration of the dispatch and restored after — a
+            # bisect retry re-tags against ITS OWN indices, so a
+            # failed dispatch must never leave its wrapping behind
+            wrapped = []
+            for i, r in enumerate(reqs):
+                for job in r.work.jobs:
+                    wrapped.append((job, job.payload))
+                    job.payload = (i, job.payload)
+
+            t0 = self.metrics.device_begin()
+            try:
+                sieve_handle = None
+                if files and self.secret_scanner is not None:
+                    # async enqueue: the device sieves while the
+                    # interval dispatch below compiles/queues behind
+                    sieve_handle = \
+                        self.secret_scanner.dispatch_files(files)
+
+                all_jobs = [job for job, _ in wrapped]
+                detected_by: dict = {}
+                if all_jobs:
+                    kstats: dict = {}   # per-batch, not the global
+                    for i, payload in dispatch_jobs(
+                            all_jobs, backend=group,
+                            mesh=self.mesh, stats=kstats):
+                        detected_by.setdefault(i, []).append(payload)
+                    with self._lock:
+                        self._kernel_s += kstats.get("device_s", 0.0)
+
+                found_by: dict = {}
+                if sieve_handle is not None:
+                    for idx, secret in self.secret_scanner.collect(
+                            sieve_handle):
+                        found_by.setdefault(owner[idx], []).append(
+                            (local[idx], secret))
+            finally:
+                for job, orig in wrapped:
+                    job.payload = orig
+                self.metrics.device_end(t0)
+            self.metrics.observe("device", time.monotonic() - t0)
+        except Exception as e:       # noqa: BLE001
+            for sp in spans:
+                sp.event("device_failed", error=repr(e))
+                sp.end("error")
+            raise
+        for sp in spans:
+            sp.end()
         return {id(r): (found_by.get(i, []), detected_by.get(i, []))
                 for i, r in enumerate(reqs)}
 
-    def _dispatch_isolated(self, reqs: list, group: str) -> dict:
+    def _dispatch_isolated(self, reqs: list, group: str,
+                           depth: int = 0,
+                           batch_id: int = 0) -> dict:
         """Dispatch with failure isolation: a raising batch is
         bisected until the poison request(s) are cornered alone,
         retried bounded, then quarantined to the exact host path —
         the rest of the batch completes normally. Only a request
         whose host fallback ALSO fails resolves with an error."""
         try:
-            return self._dispatch(reqs, group)
+            return self._dispatch(reqs, group, depth=depth,
+                                  batch_id=batch_id)
         except Exception as e:       # noqa: BLE001
             if len(reqs) == 1:
-                return self._quarantine(reqs[0], group, e)
+                return self._quarantine(reqs[0], group, e,
+                                        depth=depth,
+                                        batch_id=batch_id)
             log.warning("device dispatch failed for %d requests "
                         "(%r); bisecting", len(reqs), e)
             self.metrics.inc("batch_bisects")
+            for r in reqs:
+                if r.span_root is not None:
+                    r.span_root.event("batch_bisect",
+                                      depth=depth + 1,
+                                      requests=len(reqs))
             mid = (len(reqs) + 1) // 2
-            out = self._dispatch_isolated(reqs[:mid], group)
-            out.update(self._dispatch_isolated(reqs[mid:], group))
+            out = self._dispatch_isolated(reqs[:mid], group,
+                                          depth + 1, batch_id)
+            out.update(self._dispatch_isolated(reqs[mid:], group,
+                                               depth + 1, batch_id))
             return out
 
     def _quarantine(self, req: ScanRequest, group: str,
-                    err: BaseException) -> dict:
+                    err: BaseException, depth: int = 0,
+                    batch_id: int = 0) -> dict:
         """Single failing request: bounded on-device retries (a
         transient may clear), then the host-fallback path."""
         for _ in range(max(0, self.config.quarantine_retries)):
             try:
-                return self._dispatch([req], group)
+                return self._dispatch([req], group, depth=depth,
+                                      batch_id=batch_id,
+                                      attempt="quarantine_retry")
             except Exception as e:   # noqa: BLE001
                 err = e
         self.metrics.inc("quarantined")
         log.warning("quarantining %r after device failure: %r",
                     req.name, err)
+        if req.span_root is not None:
+            req.span_root.event("quarantined", error=repr(err))
         req.record_fault(
             "device", "quarantined",
             f"device dispatch failed, completed on host: {err}")
+        sp = self.tracer.child(req.span_root, "host_fallback")
         try:
-            out = self._host_fallback(req)
+            with sp.activate():
+                out = self._host_fallback(req)
+            sp.end()
             self.metrics.inc("host_fallbacks")
             return out
         except Exception as e2:      # noqa: BLE001
+            sp.end("error")
             log.warning("host fallback for %r failed: %r",
                         req.name, e2)
             req.record_fault("host", "fallback_failed", str(e2))
@@ -537,29 +653,46 @@ class ScanScheduler:
     def _finish(self, req: ScanRequest, found: list,
                 detected: list) -> None:
         t0 = self.metrics.host_begin()
+        sp = self.tracer.child(req.span_root, "report")
         try:
             work = req.work
+            if work.deps and not sp.noop:
+                sp.event("deps_wait", n=len(work.deps))
             for ev in work.deps:
                 # deps are resolved by the device thread; they cannot
                 # wait on this request, so a bounded wait only guards
                 # against scheduler shutdown mid-flight
                 while not ev.wait(timeout=1.0):
                     if not self._running:
+                        sp.end("error")
                         self._fail(req, SchedulerClosed(
                             "scheduler closed"))
                         return
                     if self._sweep(req):
+                        sp.end("error")
                         return
             if self._sweep(req):
                 # expired after the device batch resolved but before
                 # assembly — abandon, the 408 already went out
                 self.metrics.inc("expired_inflight")
+                sp.end("error")
                 return
-            result = work.finish(found, detected)
-            if req.faults:
-                result = _annotate_degraded(result, req.faults)
+            with sp.activate():
+                result = work.finish(found, detected)
+                if req.faults:
+                    if not sp.noop:
+                        # the degraded report references its trace so
+                        # the operator can pull the span tree
+                        # (GET /trace/<id> / flight-recorder dump)
+                        req.faults.append(trace_cause(
+                            self.tracer, req.trace_id))
+                    result = _annotate_degraded(result, req.faults)
+            # the report span closes BEFORE the root resolves so the
+            # completed trace's children nest inside the root
+            sp.end()
             self._complete(req, result)
         except Exception as e:       # noqa: BLE001
+            sp.end("error")
             log.warning("finish %r failed: %r", req.name, e)
             self._fail(req, e)
         finally:

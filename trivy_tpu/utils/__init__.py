@@ -1,7 +1,7 @@
 import contextlib
 import gc
 
-from .log import get_logger, set_level
+from .log import get_logger, set_format, set_level
 
 
 @contextlib.contextmanager
@@ -22,4 +22,4 @@ def defer_gc():
             gc.enable()
             gc.collect()
 
-__all__ = ["get_logger", "set_level"]
+__all__ = ["get_logger", "set_format", "set_level"]

@@ -1,12 +1,46 @@
-"""Logging setup (reference analog: pkg/log zap SugaredLogger)."""
+"""Logging setup (reference analog: pkg/log zap SugaredLogger).
+
+Two wire formats on the same stderr handler: the default tab-
+separated text, and ``--log-format json`` — one JSON object per
+line carrying ``trace_id``/``request_id`` from the active span, so
+server logs correlate with the per-request traces the obs layer
+records (docs/observability.md).
+"""
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
 
 _FMT = "%(asctime)s\t%(levelname)s\t%(message)s"
 _DATEFMT = "%Y-%m-%dT%H:%M:%S"
+
+
+class JsonFormatter(logging.Formatter):
+    """Structured log lines: ts/level/logger/msg plus the tracing
+    correlation ids when a traced request is active on the emitting
+    thread."""
+
+    def format(self, record) -> str:
+        out = {"ts": self.formatTime(record, _DATEFMT),
+               "level": record.levelname,
+               "logger": record.name,
+               "msg": record.getMessage()}
+        if record.exc_info and record.exc_info[1] is not None:
+            out["exc"] = repr(record.exc_info[1])
+        try:
+            from ..obs.trace import current_span
+            span = current_span()
+        except Exception:           # noqa: BLE001 — logging must
+            span = None             # never raise
+        if span is not None and not span.noop:
+            out["trace_id"] = span.trace_id
+            rid = span.attrs.get("request")
+            if rid:
+                out["request_id"] = rid
+        return json.dumps(out, ensure_ascii=False)
+
 
 _root = logging.getLogger("trivy_tpu")
 if not _root.handlers:
@@ -15,6 +49,8 @@ if not _root.handlers:
     _root.addHandler(_h)
     _root.setLevel(logging.INFO)
     _root.propagate = False
+else:
+    _h = _root.handlers[0]
 
 
 def get_logger(name: str = "") -> logging.Logger:
@@ -28,3 +64,22 @@ def set_level(debug: bool = False, quiet: bool = False) -> None:
         _root.setLevel(logging.DEBUG)
     else:
         _root.setLevel(logging.INFO)
+
+
+def set_format(fmt: str) -> None:
+    """``text`` (default) or ``json`` (structured lines with trace
+    correlation ids). Unknown values raise so a typo'd --log-format
+    fails the run up front."""
+    if fmt in ("", "text", "plain"):
+        _h.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    elif fmt == "json":
+        _h.setFormatter(JsonFormatter())
+    else:
+        raise ValueError(f"unknown log format {fmt!r} "
+                         "(choose text or json)")
+
+
+def attach_handler(handler: logging.Handler) -> None:
+    """Attach an extra handler (the flight recorder's log ring)."""
+    if handler not in _root.handlers:
+        _root.addHandler(handler)
