@@ -1,0 +1,97 @@
+"""Trace coverage for the packing/upload phases (``pytest -m obs``):
+``pack`` (segment + interval-table packing), ``h2d_upload`` (segment
+buffer crossing the tunnel) and ``db_upload`` (resident advisory
+tables staged to HBM) must appear as spans under the PR-4 tracer on
+both execution paths, so Perfetto shows where host time goes
+(docs/performance.md)."""
+
+import pytest
+
+from tests.test_sched import make_fleet, make_store
+from trivy_tpu.sched import SchedConfig
+
+pytestmark = pytest.mark.obs
+
+
+def _phases(tracer) -> dict:
+    return {name: h["count"]
+            for name, h in tracer.phase_snapshot().items()}
+
+
+def test_phase_spans_present_scheduled(tmp_path):
+    """Scheduled path, resident DB: all three phases record spans
+    (children of the batch's first device span)."""
+    from trivy_tpu.db.compiled import CompiledDB
+    from trivy_tpu.obs import Tracer
+    from trivy_tpu.runtime import BatchScanRunner
+
+    tracer = Tracer()
+    cdb = CompiledDB.compile(make_store())
+    runner = BatchScanRunner(
+        store=cdb, backend="tpu",
+        sched=SchedConfig(flush_timeout_s=0.01, workers=4),
+        tracer=tracer)
+    try:
+        results = runner.scan_paths(make_fleet(tmp_path, 3))
+    finally:
+        runner.close()
+    assert all(r.status == "ok" for r in results)
+    phases = _phases(tracer)
+    assert phases.get("pack", 0) > 0, phases
+    assert phases.get("h2d_upload", 0) > 0, phases
+    assert phases.get("db_upload", 0) > 0, phases
+
+
+def test_phase_spans_present_direct(tmp_path):
+    """Direct (--sched off) path: pack + h2d_upload spans attach
+    under the fleet's shared device span; a fresh compiled DB adds
+    db_upload."""
+    from trivy_tpu.db.compiled import CompiledDB
+    from trivy_tpu.obs import Tracer
+    from trivy_tpu.runtime import BatchScanRunner
+
+    tracer = Tracer()
+    cdb = CompiledDB.compile(make_store())
+    runner = BatchScanRunner(store=cdb, backend="tpu",
+                             tracer=tracer)
+    results = runner.scan_paths(make_fleet(tmp_path, 3))
+    assert all(r.status == "ok" for r in results)
+    phases = _phases(tracer)
+    assert phases.get("pack", 0) > 0, phases
+    assert phases.get("h2d_upload", 0) > 0, phases
+    assert phases.get("db_upload", 0) > 0, phases
+
+
+def test_db_upload_span_carries_generation():
+    """The db_upload span records generation + byte volume — the
+    attrs an operator needs to audit upload amortization."""
+    from trivy_tpu.db.compiled import CompiledDB
+    from trivy_tpu.obs import Tracer
+
+    tracer = Tracer()
+    cdb = CompiledDB.compile(make_store())
+    root = tracer.start_request("upload-audit")
+    with root.activate():
+        cdb.device_tables()
+        cdb.device_tables()        # second call reuses the buffers
+    root.end()
+    spans = tracer.recorder.get(root.trace_id)
+    uploads = [s for s in spans if s.name == "db_upload"]
+    assert len(uploads) == 1       # one upload, many dispatches
+    assert uploads[0].attrs["generation"] == cdb.generation
+    assert uploads[0].attrs["bytes"] > 0
+    assert cdb.device_stats()["dispatches"] == 2
+
+
+def test_disabled_tracer_records_nothing(tmp_path):
+    """phase_span is a no-op without an active span — the untraced
+    arm stays untraced (the obs bench's differential)."""
+    from trivy_tpu.obs import Tracer
+    from trivy_tpu.runtime import BatchScanRunner
+
+    tracer = Tracer(enabled=False)
+    runner = BatchScanRunner(store=make_store(), backend="tpu",
+                             tracer=tracer)
+    runner.scan_paths(make_fleet(tmp_path, 2))
+    assert tracer.n_spans == 0
+    assert _phases(tracer) == {}

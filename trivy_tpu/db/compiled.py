@@ -124,6 +124,20 @@ class _Row:
     flags: int = 0
 
 
+_GENERATION_LOCK = threading.Lock()
+_GENERATION_SEQ = [0]
+
+
+def _next_generation() -> int:
+    """Process-monotonic DB generation key: every compile/load gets
+    a fresh one, so device buffers, caches and metrics can tell "the
+    same tables again" from "a hot-swapped update" without hashing
+    gigabytes (docs/performance.md)."""
+    with _GENERATION_LOCK:
+        _GENERATION_SEQ[0] += 1
+        return _GENERATION_SEQ[0]
+
+
 class CompiledDB:
     """Flattened advisory tables + join index. Read-only after
     ``compile`` / ``load``."""
@@ -138,7 +152,11 @@ class CompiledDB:
         self.vulnerabilities: dict = {}
         self.data_sources: dict = {}
         self.stats: dict = {}
+        self.generation = _next_generation()
         self._device: dict = {}
+        self._device_lock = threading.Lock()
+        self._device_stats = {"uploads": 0, "upload_bytes": 0,
+                              "dispatches": 0, "invalidations": 0}
         self._parse_cache: dict = {}
 
     # ---- compile ----
@@ -248,12 +266,14 @@ class CompiledDB:
                list(adv.patched_versions)):
             row.flags = F_FORCE
             return
+        from ..detect.ccache import INTERVAL_CACHE
         if adv.vulnerable_versions:
             row.flags |= F_HAS_VULN
             for c in " || ".join(adv.vulnerable_versions).split("||"):
                 if not c.strip():
                     raise ValueError("empty constraint alternative")
-                row.vuln_ivs.extend(comparer.constraint_intervals(c))
+                row.vuln_ivs.extend(INTERVAL_CACHE.intervals(
+                    row.grammar, comparer, c))
         secure = list(adv.patched_versions) + \
             list(adv.unaffected_versions)
         if secure:
@@ -261,7 +281,8 @@ class CompiledDB:
             for c in " || ".join(secure).split("||"):
                 if not c.strip():
                     raise ValueError("empty constraint alternative")
-                row.sec_ivs.extend(comparer.constraint_intervals(c))
+                row.sec_ivs.extend(INTERVAL_CACHE.intervals(
+                    row.grammar, comparer, c))
         if len(row.vuln_ivs) > MAX_INTERVALS or \
                 len(row.sec_ivs) > MAX_INTERVALS:
             row.vuln_ivs, row.sec_ivs = [], []
@@ -378,20 +399,63 @@ class CompiledDB:
 
     def device_tables(self, mesh=None):
         """Push tables to the default device (or replicated across a
-        mesh) once; reuse across scans. Returns (v_lo, v_hi, s_lo,
-        s_hi, flags) device arrays."""
+        mesh) ONCE per (generation, mesh); every later dispatch keys
+        against the resident buffers instead of re-transferring the
+        advisory operands. Returns (v_lo, v_hi, s_lo, s_hi, flags)
+        device arrays. ``invalidate_device`` (hot-swap / ``trivy db
+        update``) drops the buffers so the superseded generation's
+        HBM is reclaimed as soon as its last reader finishes."""
         import jax
+
+        from ..detect.metrics import DETECT_METRICS
+        from ..obs.trace import phase_span
         key = "default" if mesh is None else mesh
-        if key not in self._device:
-            arrs = (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
-                    self.flags)
-            if mesh is None:
-                placed = tuple(jax.device_put(a) for a in arrs)
-            else:
-                from ..parallel.interval_shard import replicate_tables
-                placed = replicate_tables(mesh, arrs)
-            self._device[key] = placed
-        return self._device[key]
+        with self._device_lock:
+            placed = self._device.get(key)
+            if placed is None:
+                arrs = (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
+                        self.flags)
+                nbytes = int(sum(a.nbytes for a in arrs))
+                with phase_span("db_upload", bytes=nbytes,
+                                generation=self.generation,
+                                rows=int(len(self.flags))):
+                    if mesh is None:
+                        placed = tuple(jax.device_put(a)
+                                       for a in arrs)
+                    else:
+                        from ..parallel.interval_shard import \
+                            replicate_tables
+                        placed = replicate_tables(mesh, arrs)
+                self._device[key] = placed
+                self._device_stats["uploads"] += 1
+                self._device_stats["upload_bytes"] += nbytes
+                DETECT_METRICS.note_db_upload(nbytes)
+            self._device_stats["dispatches"] += 1
+        DETECT_METRICS.inc("resident_dispatches")
+        return placed
+
+    def invalidate_device(self) -> None:
+        """Drop this generation's device buffers (DB update path).
+        In-flight dispatches keep their references alive until they
+        finish; jax frees the HBM when the last one drops."""
+        from ..detect.metrics import DETECT_METRICS
+        with self._device_lock:
+            if not self._device:
+                return
+            self._device.clear()
+            self._device_stats["invalidations"] += 1
+        DETECT_METRICS.inc("db_invalidations")
+
+    def device_stats(self) -> dict:
+        """Upload-amortization numbers for bench/metrics: how many
+        dispatches each HBM upload served."""
+        with self._device_lock:
+            out = dict(self._device_stats)
+        out["generation"] = self.generation
+        out["amortization"] = round(
+            out["dispatches"] / out["uploads"], 2) \
+            if out["uploads"] else 0.0
+        return out
 
     # ---- enrichment reads (db.Config parity) ----
 
@@ -582,4 +646,13 @@ class SwappableStore:
         with self._lock:
             while self._readers:
                 self._no_readers.wait()
-            self._db = new_db
+            old, self._db = self._db, new_db
+        # the superseded generation's resident buffers are explicitly
+        # invalidated (``trivy db update`` lifecycle): dispatches
+        # already holding the tuple finish on it, new dispatches key
+        # against the new generation, and the old HBM frees as soon
+        # as the last in-flight reference drops. getattr: the holder
+        # also fronts plain AdvisoryStores (no device residency)
+        drop = getattr(old, "invalidate_device", None)
+        if drop is not None and old is not new_db:
+            drop()

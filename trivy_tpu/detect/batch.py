@@ -7,6 +7,15 @@ ospkg/drivers.py) — guaranteed because interval compilation is exact
 over the finite rank universe, pairs whose constraints exceed
 MAX_INTERVALS or fail to parse fall back to the host path, and the
 doubled rank space captures bound exclusivity exactly.
+
+Dispatch shape (docs/performance.md): jobs are DEDUPED before any
+compilation — fleets repeat (version, constraint) pairs massively
+(every SBOM in a batch depends on the same lodash), so the kernel
+evaluates each distinct pair once and the hit fans back out to every
+duplicate's payload. Row tables are packed with bulk fancy-index
+stores into PREALLOCATED buffers padded to a small bucket ladder, so
+XLA's compile cache is keyed by a handful of shapes instead of one
+per arbitrary batch size.
 """
 
 from __future__ import annotations
@@ -22,6 +31,8 @@ from ..ops.intervals import (MAX_INTERVALS, NEG_INF, POS_INF,
 from ..utils import get_logger
 from ..vercmp import get_comparer
 from ..vercmp.base import Interval
+from .ccache import INTERVAL_CACHE
+from .metrics import DETECT_METRICS
 
 log = get_logger("detect.batch")
 
@@ -41,6 +52,15 @@ class PairJob:
     affected_version: str = ""
     report_unfixed: bool = True
     kind: str = "library"           # "library" | "ospkg"
+
+    def dedup_key(self) -> tuple:
+        """Everything that affects evaluation — NOT the payload.
+        Jobs sharing a key are provably equivalent, so one kernel
+        row serves all of them."""
+        return (self.kind, self.grammar, self.pkg_version,
+                tuple(self.vulnerable), tuple(self.patched),
+                tuple(self.unaffected), self.fixed_version,
+                self.affected_version, self.report_unfixed)
 
 
 class _RankSpace:
@@ -81,6 +101,34 @@ class _RankSpace:
 last_dispatch_stats: dict = {"device_s": 0.0}
 
 
+def _job_bucket(n: int) -> int:
+    """Pair-row shape ladder: powers of two up to 8192, then
+    8192-steps (the shared ops.keywords ladder with pair-row
+    constants). Pad rows are inert (flags=0 → never hit) and the
+    caller trims the output, so the only cost is a few wasted lanes
+    — repaid many times over by XLA compile-cache hits."""
+    from ..ops.keywords import _bucket
+    return _bucket(n, base=64, cap=8192)
+
+
+def _dedup(jobs: list, key_fn) -> tuple:
+    """(representatives, members): one representative job per
+    distinct key, plus the original job index list behind each."""
+    index: dict = {}
+    reps: list = []
+    members: list = []
+    for i, job in enumerate(jobs):
+        k = key_fn(job)
+        gi = index.get(k)
+        if gi is None:
+            index[k] = len(reps)
+            reps.append(job)
+            members.append([i])
+        else:
+            members[gi].append(i)
+    return reps, members
+
+
 def detect_pairs(jobs: list, backend: str = "tpu",
                  mesh=None, stats: Optional[dict] = None) -> list:
     """Returns payloads of vulnerable pairs, batch order preserved.
@@ -88,12 +136,17 @@ def detect_pairs(jobs: list, backend: str = "tpu",
     parallel.interval_shard)."""
     if not jobs:
         return []
+    from ..obs.trace import phase_span
     sink = stats if stats is not None else last_dispatch_stats
-    spaces: dict = {}
-    rows = []          # (job, pkg_key, vuln_ivs, sec_ivs, flags)
-    host_jobs = []     # fallback: (index, job)
+    reps, members = _dedup(jobs, PairJob.dedup_key)
+    sink["jobs_in"] = sink.get("jobs_in", 0) + len(jobs)
+    sink["jobs_unique"] = sink.get("jobs_unique", 0) + len(reps)
+    DETECT_METRICS.note_dispatch(len(jobs), len(reps))
 
-    for job in jobs:
+    spaces: dict = {}
+    rows = []          # (group idx, job, pkg_key, vuln, sec, flags)
+    host_groups = []   # fallback: group indices
+    for gi, job in enumerate(reps):
         sp = spaces.setdefault(job.grammar, _RankSpace(job.grammar))
         try:
             pkg_key = sp.key(job.pkg_version)
@@ -103,35 +156,57 @@ def detect_pairs(jobs: list, backend: str = "tpu",
         try:
             vuln_ivs, sec_ivs, flags = _compile(job, sp)
         except _HostFallback:
-            host_jobs.append(job)
+            host_groups.append(gi)
             continue
         except ValueError as e:
             log.debug("constraint error: %s", e)
             continue                      # reference: warn + not vuln
         if flags is None:
             continue                      # statically not vulnerable
-        rows.append((job, pkg_key, vuln_ivs, sec_ivs, flags))
+        rows.append((gi, job, pkg_key, vuln_ivs, sec_ivs, flags))
 
-    out = []
+    hit_jobs: list = []          # original job indices that hit
     if rows:
-        for sp in spaces.values():
-            sp.finalize()
-        P = len(rows)
-        pkg_rank = np.zeros(P, np.int32)
-        v_lo = np.full((P, MAX_INTERVALS), POS_INF, np.int32)
-        v_hi = np.full((P, MAX_INTERVALS), NEG_INF, np.int32)
-        s_lo = np.full((P, MAX_INTERVALS), POS_INF, np.int32)
-        s_hi = np.full((P, MAX_INTERVALS), NEG_INF, np.int32)
-        flags_arr = np.zeros(P, np.int32)
-        for i, (job, pkg_key, vuln_ivs, sec_ivs, flags) in \
-                enumerate(rows):
-            sp = spaces[job.grammar]
-            pkg_rank[i] = sp.rank(pkg_key)
-            for j, iv in enumerate(vuln_ivs):
-                v_lo[i, j], v_hi[i, j] = sp.encode(iv)
-            for j, iv in enumerate(sec_ivs):
-                s_lo[i, j], s_hi[i, j] = sp.encode(iv)
-            flags_arr[i] = flags
+        with phase_span("pack", jobs=len(jobs), unique=len(reps)):
+            for sp in spaces.values():
+                sp.finalize()
+            P = len(rows)
+            Pp = P if backend == "cpu-ref" else _job_bucket(P)
+            pkg_rank = np.zeros(Pp, np.int32)
+            v_lo = np.full((Pp, MAX_INTERVALS), POS_INF, np.int32)
+            v_hi = np.full((Pp, MAX_INTERVALS), NEG_INF, np.int32)
+            s_lo = np.full((Pp, MAX_INTERVALS), POS_INF, np.int32)
+            s_hi = np.full((Pp, MAX_INTERVALS), NEG_INF, np.int32)
+            flags_arr = np.zeros(Pp, np.int32)
+            # encode per row, store with ONE fancy-index write per
+            # table instead of one scalar store per interval slot
+            vi: list = []
+            vj: list = []
+            vb: list = []
+            si: list = []
+            sj: list = []
+            sb: list = []
+            for i, (gi, job, pkg_key, vuln_ivs, sec_ivs, flags) in \
+                    enumerate(rows):
+                sp = spaces[job.grammar]
+                pkg_rank[i] = sp.rank(pkg_key)
+                flags_arr[i] = flags
+                for j, iv in enumerate(vuln_ivs):
+                    vi.append(i)
+                    vj.append(j)
+                    vb.append(sp.encode(iv))
+                for j, iv in enumerate(sec_ivs):
+                    si.append(i)
+                    sj.append(j)
+                    sb.append(sp.encode(iv))
+            if vb:
+                b = np.asarray(vb, np.int32)
+                v_lo[vi, vj] = b[:, 0]
+                v_hi[vi, vj] = b[:, 1]
+            if sb:
+                b = np.asarray(sb, np.int32)
+                s_lo[si, sj] = b[:, 0]
+                s_hi[si, sj] = b[:, 1]
         import time as _time
         t0 = _time.perf_counter()
         if backend == "cpu-ref":
@@ -146,18 +221,28 @@ def detect_pairs(jobs: list, backend: str = "tpu",
                 pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
         sink["device_s"] = sink.get("device_s", 0.0) + \
             _time.perf_counter() - t0
-        out.extend(rows[i][0].payload for i in np.nonzero(hits)[0])
+        for i in np.nonzero(hits[:P])[0]:
+            hit_jobs.extend(members[rows[i][0]])
 
-    # host fallback pairs: exact per-pair evaluation
-    for job in host_jobs:
-        if _host_eval(job):
-            out.append(job.payload)
+    out = [jobs[i].payload for i in sorted(hit_jobs)]
+
+    # host fallback pairs: exact per-pair evaluation, once per
+    # distinct key — the verdict fans out to every duplicate
+    host_hits: list = []
+    for gi in host_groups:
+        if _host_eval(reps[gi]):
+            host_hits.extend(members[gi])
+    out.extend(jobs[i].payload for i in sorted(host_hits))
     return out
 
 
 def _device_hits(*arrs):
-    import jax.numpy as jnp
-    return interval_hits(*(jnp.asarray(a) for a in arrs))
+    import jax
+    from ..obs.trace import phase_span
+    with phase_span("h2d_upload",
+                    bytes=int(sum(a.nbytes for a in arrs))):
+        dev = [jax.device_put(a) for a in arrs]
+    return interval_hits(*dev)
 
 
 class _HostFallback(Exception):
@@ -173,7 +258,6 @@ def _compile(job: PairJob, sp: _RankSpace):
     flags = 0
     if any(v == "" for v in list(job.vulnerable) + list(job.patched)):
         return [], [], 2                  # force-vulnerable
-
     # node-semver's prerelease-exclusion rule is not an interval
     # property; prerelease npm versions take the exact host path
     if getattr(sp.comparer, "is_prerelease",
@@ -186,8 +270,8 @@ def _compile(job: PairJob, sp: _RankSpace):
         for constraint in " || ".join(job.vulnerable).split("||"):
             if not constraint.strip():
                 raise ValueError("empty constraint alternative")
-            vuln_ivs.extend(
-                sp.comparer.constraint_intervals(constraint))
+            vuln_ivs.extend(INTERVAL_CACHE.intervals(
+                job.grammar, sp.comparer, constraint))
     secure = list(job.patched) + list(job.unaffected)
     sec_ivs: list = []
     if secure:
@@ -195,8 +279,8 @@ def _compile(job: PairJob, sp: _RankSpace):
         for constraint in " || ".join(secure).split("||"):
             if not constraint.strip():
                 raise ValueError("empty constraint alternative")
-            sec_ivs.extend(
-                sp.comparer.constraint_intervals(constraint))
+            sec_ivs.extend(INTERVAL_CACHE.intervals(
+                job.grammar, sp.comparer, constraint))
     if len(vuln_ivs) > MAX_INTERVALS or len(sec_ivs) > MAX_INTERVALS:
         raise _HostFallback
     for iv in vuln_ivs + sec_ivs:
@@ -249,45 +333,78 @@ class ResidentPairJob:
     report_unfixed: bool = True
     payload: object = None
 
+    def dedup_key(self) -> tuple:
+        # the DB identity is part of the key: row N of one compiled
+        # generation says nothing about row N of another, and a
+        # caller may hand detect_pairs_resident a mixed list even
+        # though dispatch_jobs groups by store first
+        return (getattr(self.cdb, "generation", id(self.cdb)),
+                self.row, self.grammar, self.pkg_version,
+                self.report_unfixed)
+
 
 def detect_pairs_resident(jobs: list, backend: str = "tpu",
                           mesh=None,
                           stats: Optional[dict] = None) -> list:
     """Evaluate ResidentPairJobs in one gather-dispatch against the
-    resident tables. Host work is O(jobs): rank lookups are cached
-    per (grammar, version); the advisory universe is never touched."""
+    resident tables. Host work is O(distinct jobs): duplicates are
+    folded before rank lookup, rank lookups are cached per
+    (grammar, version), and the advisory universe is never touched."""
     if not jobs:
         return []
+    from ..obs.trace import phase_span
     sink = stats if stats is not None else last_dispatch_stats
     from ..db.compiled import F_HOST, F_UNFIXED
 
     cdb = jobs[0].cdb
-    out: list = []
-    kept: list = []
+    if any(j.cdb is not cdb for j in jobs):
+        # the kernel path below gathers from ONE store's tables;
+        # a mixed list (dispatch_jobs pre-groups, direct callers
+        # may not) evaluates per store
+        by_db: dict = {}
+        for j in jobs:
+            by_db.setdefault(id(j.cdb), []).append(j)
+        out = []
+        for js in by_db.values():
+            out.extend(detect_pairs_resident(
+                js, backend=backend, mesh=mesh, stats=stats))
+        return out
+    reps, members = _dedup(jobs, ResidentPairJob.dedup_key)
+    sink["jobs_in"] = sink.get("jobs_in", 0) + len(jobs)
+    sink["jobs_unique"] = sink.get("jobs_unique", 0) + len(reps)
+    DETECT_METRICS.note_dispatch(len(jobs), len(reps))
+
+    kept: list = []              # group indices on the kernel path
     ranks: list = []
     rows: list = []
-    host: list = []
-    for job in jobs:
-        flags = int(cdb.flags[job.row])
-        if (flags & F_UNFIXED) and not job.report_unfixed:
-            continue
-        comparer = get_comparer(job.grammar)
-        if (flags & F_HOST) or getattr(
-                comparer, "is_prerelease",
-                lambda v: False)(job.pkg_version):
-            host.append(job)
-            continue
-        r = cdb.pkg_rank(job.grammar, job.pkg_version)
-        if r is None:
-            continue                     # version parse error: skip
-        kept.append(job)
-        ranks.append(r)
-        rows.append(job.row)
+    host: list = []              # group indices on the host path
+    with phase_span("pack", jobs=len(jobs), unique=len(reps)):
+        for gi, job in enumerate(reps):
+            flags = int(cdb.flags[job.row])
+            if (flags & F_UNFIXED) and not job.report_unfixed:
+                continue
+            comparer = get_comparer(job.grammar)
+            if (flags & F_HOST) or getattr(
+                    comparer, "is_prerelease",
+                    lambda v: False)(job.pkg_version):
+                host.append(gi)
+                continue
+            r = cdb.pkg_rank(job.grammar, job.pkg_version)
+            if r is None:
+                continue                 # version parse error: skip
+            kept.append(gi)
+            ranks.append(r)
+            rows.append(job.row)
 
+    hit_jobs: list = []
     if kept:
         import time as _time
-        pkg_rank = np.asarray(ranks, np.int32)
-        row_idx = np.asarray(rows, np.int32)
+        P = len(kept)
+        Pp = P if backend == "cpu-ref" else _job_bucket(P)
+        pkg_rank = np.zeros(Pp, np.int32)
+        row_idx = np.zeros(Pp, np.int32)
+        pkg_rank[:P] = ranks
+        row_idx[:P] = rows
         t0 = _time.perf_counter()
         if backend == "cpu-ref":
             hits = interval_hits_host(
@@ -301,18 +418,27 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
             hits = sharded_interval_hits_resident(
                 mesh, pkg_rank, row_idx, tables)
         else:
-            import jax.numpy as jnp
+            import jax
             from ..ops.intervals import interval_hits_resident
             tables = cdb.device_tables()
             hits = np.asarray(interval_hits_resident(
-                jnp.asarray(pkg_rank), jnp.asarray(row_idx), *tables))
+                jax.device_put(pkg_rank), jax.device_put(row_idx),
+                *tables))
         sink["device_s"] = sink.get("device_s", 0.0) + \
             _time.perf_counter() - t0
-        out.extend(kept[i].payload for i in np.nonzero(hits)[0])
+        for i in np.nonzero(hits[:P])[0]:
+            hit_jobs.extend(members[kept[i]])
+    out = [jobs[i].payload for i in sorted(hit_jobs)]
 
-    for job in host:
+    host_hits: list = []
+    for gi in host:
+        job = reps[gi]
+        # each job's OWN store, not the batch head's — the kernel
+        # path above assumes a homogeneous batch, the host path
+        # need not
         if job.cdb.host_eval(job.row, job.pkg_version):
-            out.append(job.payload)
+            host_hits.extend(members[gi])
+    out.extend(jobs[i].payload for i in sorted(host_hits))
     return out
 
 
@@ -320,10 +446,13 @@ def dispatch_jobs(jobs: list, backend: str = "tpu",
                   mesh=None, stats: Optional[dict] = None) -> list:
     """Mixed-job dispatcher: classic PairJobs (per-dispatch compile)
     and ResidentPairJobs (compiled store), each in one kernel call.
-    ``stats`` (optional) receives this call's device_s instead of
-    the shared module global — pass one per thread."""
+    ``stats`` (optional) receives this call's device_s and the
+    dedup counters (``jobs_in`` / ``jobs_unique``) instead of the
+    shared module global — pass one per thread."""
     sink = stats if stats is not None else last_dispatch_stats
     sink["device_s"] = 0.0
+    sink["jobs_in"] = 0
+    sink["jobs_unique"] = 0
     plain = [j for j in jobs if isinstance(j, PairJob)]
     resident = [j for j in jobs if isinstance(j, ResidentPairJob)]
     out = detect_pairs(plain, backend=backend, mesh=mesh,

@@ -10,12 +10,12 @@ imports the hot path pays for.
 from .prom import render_prometheus
 from .recorder import FlightRecorder, RingLogHandler
 from .trace import (NOOP_SPAN, Span, Tracer, add_event, current_span,
-                    get_tracer, new_trace_id, summarize, to_chrome,
-                    trace_cause)
+                    get_tracer, new_trace_id, phase_span, summarize,
+                    to_chrome, trace_cause)
 
 __all__ = [
     "FlightRecorder", "NOOP_SPAN", "RingLogHandler", "Span",
     "Tracer", "add_event", "current_span", "get_tracer",
-    "new_trace_id", "render_prometheus", "summarize", "to_chrome",
-    "trace_cause",
+    "new_trace_id", "phase_span", "render_prometheus", "summarize",
+    "to_chrome", "trace_cause",
 ]

@@ -151,6 +151,38 @@ def render_prometheus(stats: dict, phase_hists=None,
         for k in sorted(guard):
             w.sample(name, [("event", k)], guard[k])
 
+    detect = stats.get("detect") or {}
+    if detect:
+        name = f"{_PREFIX}_detect_events_total"
+        w.header(name, "counter",
+                 "Dispatch-path counters (job dedup, cache "
+                 "hits/misses, resident-DB uploads).")
+        for k in sorted(detect):
+            if k.endswith(("_rate", "_ratio", "amortization")) \
+                    or k == "db_upload_bytes":
+                continue     # derived gauges / byte totals below —
+                # a byte count inside an event-count family would
+                # poison any sum() over it
+            w.sample(name, [("event", k)], detect[k])
+        w.scalar(f"{_PREFIX}_detect_db_upload_bytes_total",
+                 "counter",
+                 "Bytes of advisory tables staged to HBM.",
+                 detect.get("db_upload_bytes"))
+        w.scalar(f"{_PREFIX}_detect_dedup_ratio", "gauge",
+                 "Share of interval jobs folded away by dedup.",
+                 detect.get("dedup_ratio"))
+        w.scalar(f"{_PREFIX}_detect_interval_cache_hit_rate",
+                 "gauge",
+                 "Constraint-interval compile cache hit rate.",
+                 detect.get("interval_cache_hit_rate"))
+        w.scalar(f"{_PREFIX}_detect_purl_cache_hit_rate", "gauge",
+                 "Purl parse cache hit rate.",
+                 detect.get("purl_cache_hit_rate"))
+        w.scalar(f"{_PREFIX}_detect_db_upload_amortization",
+                 "gauge",
+                 "Resident-table dispatches served per HBM upload.",
+                 detect.get("upload_amortization"))
+
     idem = stats.get("idempotency") or {}
     if idem:
         w.scalar(f"{_PREFIX}_idempotency_entries", "gauge",

@@ -80,6 +80,43 @@ def add_event(name: str, **attrs) -> None:
         span.event(name, **attrs)
 
 
+class _PhaseSpanCtx:
+    """Context manager behind :func:`phase_span`: opens a child of
+    the active span (activated, so nested phases chain), ends it on
+    exit — with status "error" when the body raised."""
+
+    __slots__ = ("name", "attrs", "span", "_token")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.span = NOOP_SPAN
+        self._token = None
+
+    def __enter__(self):
+        parent = _ACTIVE.get()
+        if parent is not None and not parent.noop:
+            self.span = parent.tracer.child(parent, self.name,
+                                            **self.attrs)
+            self._token = _ACTIVE.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, *exc):
+        if self._token is not None:
+            _ACTIVE.reset(self._token)
+        self.span.end("error" if exc_type is not None else None)
+
+
+def phase_span(name: str, **attrs) -> _PhaseSpanCtx:
+    """``with phase_span("pack"):`` — bracket a pipeline phase as a
+    child of whatever span is active on this thread, or do nothing
+    when none is. This is how deep seams (segment packing, H2D
+    uploads, resident-DB staging) show up in Perfetto without
+    threading a tracer handle through every call chain
+    (docs/performance.md)."""
+    return _PhaseSpanCtx(name, attrs)
+
+
 class _SpanContext:
     __slots__ = ("span", "_token")
 

@@ -287,17 +287,19 @@ def make_full_sieve(literals: tuple, platform: str):
     return full
 
 
-def _bucket(n: int) -> int:
+def _bucket(n: int, base: int = 256, cap: int = 4096) -> int:
     """Round batch sizes up to a small set of shapes so jit caches
     stay warm (pad rows are zeros — they match nothing real).
-    Powers of two up to 4096, then 4096-steps (a 40k-segment batch
-    should not pad to 64k)."""
-    b = 256
-    while b < n and b < 4096:
+    Powers of two from ``base`` up to ``cap``, then ``cap``-steps
+    (a 40k-segment batch should not pad to 64k). The defaults are
+    the segment-buffer ladder; detect/batch.py reuses this with a
+    64/8192 ladder for pair rows."""
+    b = base
+    while b < n and b < cap:
         b *= 2
     if n <= b:
         return b
-    return ((n + 4095) // 4096) * 4096
+    return ((n + cap - 1) // cap) * cap
 
 
 def pad_batch(segments: np.ndarray) -> np.ndarray:

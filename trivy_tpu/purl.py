@@ -190,8 +190,38 @@ def _unq(x: str) -> str:
     return unquote(x) if "%" in x else x
 
 
+_parse_lru = None
+
+
+def _parse_cache():
+    """Lazy so the module import stays light (purl is imported by
+    the types layer; detect.ccache pulls in the metrics module)."""
+    global _parse_lru
+    if _parse_lru is None:
+        from .detect.ccache import KeyedLRU
+        _parse_lru = KeyedLRU(65536, "purl_cache_hits",
+                              "purl_cache_misses")
+    return _parse_lru
+
+
 def from_string(s: str) -> PackageURL:
-    """Parse `pkg:type/namespace/name@version?quals#subpath`."""
+    """Parse `pkg:type/namespace/name@version?quals#subpath`.
+
+    Parses are memoized per input string: SBOM fleets repeat the
+    same purls across documents (every member depends on the same
+    lodash), so re-validating each occurrence is pure waste at 10k
+    scale (docs/performance.md). Callers MUTATE the returned object
+    (``file_path``, qualifier lists), so every call hands out a
+    fresh shallow copy, never the cached instance. Parse errors are
+    cached too and re-raised fresh (detect.ccache.KeyedLRU)."""
+    p = _parse_cache().lookup(s, _from_string_uncached)
+    return PackageURL(
+        type=p.type, namespace=p.namespace, name=p.name,
+        version=p.version, qualifiers=list(p.qualifiers),
+        subpath=p.subpath, file_path=p.file_path)
+
+
+def _from_string_uncached(s: str) -> PackageURL:
     if not s.startswith("pkg:"):
         raise ValueError(f"purl must start with 'pkg:': {s!r}")
     if "%" not in s and "?" not in s and "#" not in s:

@@ -339,6 +339,11 @@ class ScanServer:
             # counters (the scheduler's stats() already carry them)
             from ..guard.budget import GUARD_METRICS
             out["guard"] = GUARD_METRICS.snapshot()
+        if "detect" not in out:
+            # same for the dispatch-path counters (dedup, caches,
+            # resident-DB upload amortization)
+            from ..detect.metrics import DETECT_METRICS
+            out["detect"] = DETECT_METRICS.snapshot()
         out["admission"] = {"max_body_bytes": self.max_body_bytes,
                             "max_scan_blobs": self.max_scan_blobs}
         breaker = getattr(self.cache, "breaker_stats", None)

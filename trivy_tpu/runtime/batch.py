@@ -22,6 +22,7 @@ SURVEY.md §2.6).
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -367,18 +368,35 @@ class BatchScanRunner:
                      for idx, _ in slots}
 
         # ---- phase 2a: ENQUEUE the sieve dispatch (async) ----
-        # the device sieves while the host squashes + preps interval
-        # jobs (phases 3-4); results are collected in 2b below —
+        # the packing + enqueue runs on the host pool so the squash/
+        # join below overlaps the SEGMENT PACKING too, not just the
+        # device execution behind it; results are collected in 2b —
         # apply_layers' secret merge is re-derived afterwards via
         # applier.merge_layer_secrets, which is exactly the secret
         # part of the squash
+        from .hostpool import get_host_pool
         t0 = _time.perf_counter()
         collected = [c for a in artifacts for c in a.collected]
         sec_stats: dict = {}       # only this batch's, never stale
-        sieve_handle = None
+        sieve_handle = sieve_future = None
+        # pack/h2d_upload/db_upload phase spans attach under the
+        # fleet's first shared device span (they bracket work done
+        # once for the whole batch)
+        sp0 = next(iter(dev_spans.values()), None)
+
+        def _enqueue_sieve(files):
+            if sp0 is None:
+                return self.secret_scanner.dispatch_files(files)
+            with sp0.activate():
+                return self.secret_scanner.dispatch_files(files)
+
         if scan_secrets and collected:
-            sieve_handle = self.secret_scanner.dispatch_files(
-                [(p, c) for _, p, c in collected])
+            pool = get_host_pool()
+            files = [(p, c) for _, p, c in collected]
+            if pool is not None:
+                sieve_future = pool.submit(_enqueue_sieve, files)
+            else:
+                sieve_handle = _enqueue_sieve(files)
         secret_s = _time.perf_counter() - t0
 
         # ---- phase 3: squash + advisory join (host) ----
@@ -393,16 +411,27 @@ class BatchScanRunner:
         join_s = _time.perf_counter() - t0
 
         # ---- phase 4: ONE interval dispatch over all images ----
+        # joined AFTER the sieve enqueue so device work stays
+        # serialized on this thread (the sched executor invariant)
         t0 = _time.perf_counter()
+        if sieve_future is not None:
+            sieve_handle = sieve_future.result()
+            secret_s += _time.perf_counter() - t0
+            t0 = _time.perf_counter()
         all_jobs = []
         for idx, p in enumerate(prepared):
             for job in p.jobs:
                 job.payload = (idx, job.payload)
                 all_jobs.append(job)
         detected_by_image: dict = {}
-        for idx, payload in dispatch_jobs(all_jobs,
-                                          backend=options.backend,
-                                          mesh=self.mesh):
+        kstats: dict = {}          # this batch's dispatch counters
+        with (sp0.activate() if sp0 is not None
+              else contextlib.nullcontext()):
+            detected_pairs = dispatch_jobs(all_jobs,
+                                           backend=options.backend,
+                                           mesh=self.mesh,
+                                           stats=kstats)
+        for idx, payload in detected_pairs:
             detected_by_image.setdefault(idx, []).append(payload)
         interval_s = _time.perf_counter() - t0
 
@@ -428,7 +457,7 @@ class BatchScanRunner:
         for sp in dev_spans.values():
             sp.end()
 
-        from ..detect import batch as detect_batch
+        jobs_in = kstats.get("jobs_in", len(all_jobs))
         self.last_stats = {
             "images": len(images),
             "analyze_s": round(analyze_s, 4),
@@ -436,9 +465,12 @@ class BatchScanRunner:
             "squash_join_s": round(join_s, 4),
             "interval_dispatch_s": round(interval_s, 4),
             "interval_device_s": round(
-                detect_batch.last_dispatch_stats.get(
-                    "device_s", 0.0), 4),
+                kstats.get("device_s", 0.0), 4),
             "interval_jobs": len(all_jobs),
+            "interval_jobs_unique": kstats.get("jobs_unique", 0),
+            "interval_dedup_ratio": round(
+                1.0 - kstats.get("jobs_unique", 0) / jobs_in, 4)
+            if jobs_in else 0.0,
             "secret": sec_stats,
         }
 
@@ -554,18 +586,32 @@ class BatchScanRunner:
         options = options or ScanOptions(
             backend=self.backend, security_checks=["vuln"])
 
-        # ---- phase 1: decode + blob (host) ----
+        # ---- phase 1: decode + blob (host, pooled) ----
+        # decode is the dominant host phase at fleet scale (BENCH_r05:
+        # 4.2s of the 7.99s SBOM bench): json parse + purl decode per
+        # component. The host pool spreads per-document decodes over
+        # the spare cores; repeated purl strings short-circuit in the
+        # purl parse cache (docs/performance.md). A malformed
+        # document still fails only its own slot.
+        from .hostpool import map_in_pool
         t0 = _time.perf_counter()
         scanner = LocalScanner(self.cache, self.store)
-        prepared, metas, failures = [], [], {}
-        for i, (name, data) in enumerate(boms):
+
+        def decode_one(item):
+            name, data = item
             try:
-                atype, decoded, blob, blob_id = decode_to_blob(data)
+                return decode_to_blob(data)
             except ValueError as e:
-                # a malformed document fails its own slot, never the
-                # fleet (decode_to_blob normalizes decode crashes)
-                failures[i] = _failed_slot(name, e)
+                return e
+
+        decodes = map_in_pool(decode_one, list(boms))
+        prepared, metas, failures = [], [], {}
+        for i, ((name, _data), dec) in enumerate(zip(boms,
+                                                     decodes)):
+            if isinstance(dec, ValueError):
+                failures[i] = _failed_slot(name, dec)
                 continue
+            atype, decoded, blob, blob_id = dec
             self.cache.put_blob(blob_id, blob)
             prepared.append((i, scanner.prepare(
                 ScanTarget(name=name, artifact_id=blob_id,
@@ -581,9 +627,11 @@ class BatchScanRunner:
                 job.payload = (idx, job.payload)
                 all_jobs.append(job)
         detected: dict = {}
+        kstats: dict = {}
         for idx, payload in dispatch_jobs(all_jobs,
                                           backend=options.backend,
-                                          mesh=self.mesh):
+                                          mesh=self.mesh,
+                                          stats=kstats):
             detected.setdefault(idx, []).append(payload)
         interval_s = _time.perf_counter() - t0
 
@@ -600,15 +648,18 @@ class BatchScanRunner:
                               metadata=Metadata(os=os_found),
                               results=results,
                               cyclonedx=decoded.cyclonedx))
-        from ..detect import batch as detect_batch
+        jobs_in = kstats.get("jobs_in", len(all_jobs))
         self.last_stats = {
             "sboms": len(boms),
             "decode_s": round(decode_s, 4),
             "interval_dispatch_s": round(interval_s, 4),
             "interval_device_s": round(
-                detect_batch.last_dispatch_stats.get(
-                    "device_s", 0.0), 4),
+                kstats.get("device_s", 0.0), 4),
             "interval_jobs": len(all_jobs),
+            "interval_jobs_unique": kstats.get("jobs_unique", 0),
+            "interval_dedup_ratio": round(
+                1.0 - kstats.get("jobs_unique", 0) / jobs_in, 4)
+            if jobs_in else 0.0,
         }
         return [out[i] for i in range(len(boms))]
 

@@ -1,0 +1,76 @@
+"""Bounded host worker pool for packing and decode work
+(docs/performance.md "host/device overlap").
+
+One process-wide pool, sized to the host's spare cores, reserved for
+tasks that NEVER block on scheduler events: segment-buffer packing
+(secret/batch.py), SBOM decode (runtime/batch.py), and the direct
+path's sieve enqueue. Keeping it separate from the scheduler's
+worker pool is load-bearing, not stylistic — the scheduler pool runs
+``finish`` tasks that wait on patch events only the device thread
+resolves, so routing a pack task there while the device thread
+blocks on its future could deadlock the pipeline. Tasks here are
+pure compute with no cross-task waits, so the pool can be saturated
+safely from any thread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from ..utils import get_logger
+
+log = get_logger("runtime.hostpool")
+
+_POOL = None
+_LOCK = threading.Lock()
+
+
+def pool_size() -> int:
+    """Bounded: the spare cores past the two the device thread and
+    main loop keep busy, capped at 8 — which disables the pool
+    entirely on 1-2 core hosts, where extra threads only add GIL
+    contention. ``TRIVY_TPU_HOST_POOL`` overrides (0 disables)."""
+    env = os.environ.get("TRIVY_TPU_HOST_POOL", "")
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            log.warning("bad TRIVY_TPU_HOST_POOL=%r ignored", env)
+    return min(8, max(0, (os.cpu_count() or 1) - 2))
+
+
+def get_host_pool():
+    """The shared packing/decode pool, or None when disabled."""
+    global _POOL
+    if _POOL is None:
+        with _LOCK:
+            if _POOL is None:
+                n = pool_size()
+                if n == 0:
+                    return None
+                _POOL = ThreadPoolExecutor(
+                    max_workers=n,
+                    thread_name_prefix="trivy-hostpool")
+    return _POOL
+
+
+def map_in_pool(fn, items: list) -> list:
+    """``[fn(x) for x in items]`` spread over the pool (input order
+    preserved). Falls back to the inline loop when the pool is
+    disabled, the batch is too small to amortize the hops, or the
+    CALLER is itself a pool worker — a task that blocks on
+    ``pool.map`` of its own pool deadlocks the moment every worker
+    is such a task (the direct path's sieve enqueue runs here and
+    then packs segments through here again). ``fn`` must capture
+    its own errors — a raising task would abandon the batch."""
+    from ..detect.metrics import DETECT_METRICS
+    on_pool_thread = threading.current_thread().name.startswith(
+        "trivy-hostpool")
+    pool = get_host_pool() \
+        if len(items) > 8 and not on_pool_thread else None
+    if pool is None:
+        return [fn(x) for x in items]
+    DETECT_METRICS.inc("pack_tasks", len(items))
+    return list(pool.map(fn, items))

@@ -249,4 +249,9 @@ class SchedMetrics:
         # totals are what an operator watches on /metrics
         from ..guard.budget import GUARD_METRICS
         out["guard"] = GUARD_METRICS.snapshot()
+        # dispatch-path counters (docs/performance.md): job dedup,
+        # constraint/purl cache hit rates, resident-DB upload
+        # amortization — process-wide, like the guard totals
+        from ..detect.metrics import DETECT_METRICS
+        out["detect"] = DETECT_METRICS.snapshot()
         return out

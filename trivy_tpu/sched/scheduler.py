@@ -490,6 +490,12 @@ class ScanScheduler:
                 self.fault_injector.on_device_dispatch(
                     [r.name for r in reqs])
 
+            # the batch-shared phases (segment packing, H2D upload,
+            # resident-DB staging) record their pack/h2d_upload/
+            # db_upload spans under the FIRST request's device span
+            # — they happen once per batch, not once per request
+            batch_ctx = spans[0].activate()
+
             # flatten sieve candidates; owner map brings results
             # home by ENTRY INDEX (paths repeat across images — see
             # secret.batch)
@@ -513,30 +519,36 @@ class ScanScheduler:
 
             t0 = self.metrics.device_begin()
             try:
-                sieve_handle = None
-                if files and self.secret_scanner is not None:
-                    # async enqueue: the device sieves while the
-                    # interval dispatch below compiles/queues behind
-                    sieve_handle = \
-                        self.secret_scanner.dispatch_files(files)
+                with batch_ctx:
+                    sieve_handle = None
+                    if files and self.secret_scanner is not None:
+                        # async enqueue: the device sieves while the
+                        # interval dispatch below compiles/queues
+                        # behind
+                        sieve_handle = \
+                            self.secret_scanner.dispatch_files(files)
 
-                all_jobs = [job for job, _ in wrapped]
-                detected_by: dict = {}
-                if all_jobs:
-                    kstats: dict = {}   # per-batch, not the global
-                    for i, payload in dispatch_jobs(
-                            all_jobs, backend=group,
-                            mesh=self.mesh, stats=kstats):
-                        detected_by.setdefault(i, []).append(payload)
-                    with self._lock:
-                        self._kernel_s += kstats.get("device_s", 0.0)
+                    all_jobs = [job for job, _ in wrapped]
+                    detected_by: dict = {}
+                    if all_jobs:
+                        kstats: dict = {}  # per-batch, not global
+                        for i, payload in dispatch_jobs(
+                                all_jobs, backend=group,
+                                mesh=self.mesh, stats=kstats):
+                            detected_by.setdefault(i, []).append(
+                                payload)
+                        with self._lock:
+                            self._kernel_s += kstats.get(
+                                "device_s", 0.0)
 
-                found_by: dict = {}
-                if sieve_handle is not None:
-                    for idx, secret in self.secret_scanner.collect(
-                            sieve_handle):
-                        found_by.setdefault(owner[idx], []).append(
-                            (local[idx], secret))
+                    found_by: dict = {}
+                    if sieve_handle is not None:
+                        for idx, secret in \
+                                self.secret_scanner.collect(
+                                    sieve_handle):
+                            found_by.setdefault(
+                                owner[idx], []).append(
+                                (local[idx], secret))
             finally:
                 for job, orig in wrapped:
                     job.payload = orig

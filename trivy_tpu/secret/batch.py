@@ -74,31 +74,114 @@ class BatchSecretScanner:
 
     # --- segmenting ---
 
+    def _n_segs(self, n: int) -> int:
+        """Segment count for an ``n``-byte file: positions advance by
+        ``seg_len - overlap`` until one window reaches the end."""
+        L, step = self.seg_len, self.seg_len - self.overlap
+        if n <= L:
+            return 1
+        return 1 + -(-(n - L) // step)
+
+    def _fill_rows(self, buf: np.ndarray, row0: int, content: bytes,
+                   n_segs: int) -> None:
+        """Pack one file's overlapping segments into ``buf`` rows
+        [row0, row0+n_segs) with ONE bulk strided copy — the
+        per-chunk slice/copy loop this replaces was the dominant
+        host cost of the sieve dispatch (docs/performance.md)."""
+        L, step = self.seg_len, self.seg_len - self.overlap
+        n = len(content)
+        arr = np.frombuffer(content, np.uint8)
+        if n_segs == 1:
+            buf[row0, :n] = arr
+            return
+        total = (n_segs - 1) * step + L
+        tmp = np.zeros(total, np.uint8)
+        tmp[:n] = arr
+        # zero-copy sliding view over the padded file image; the
+        # single assignment below is the only copy that happens
+        view = np.lib.stride_tricks.as_strided(
+            tmp, (n_segs, L), (step, 1))
+        buf[row0:row0 + n_segs] = view
+
     def _segment(self, files: list) -> tuple:
         """Flatten files into [B, L] uint8 with per-file overlap
-        chaining. Returns (buffer, seg_file, seg_pos)."""
-        seg_file: list = []
-        seg_pos: list = []
-        chunks: list = []
+        chaining. Returns (buffer, seg_file, seg_pos,
+        shard_occupancy).
+
+        Layout is the device assignment: with a mesh, files are
+        placed into per-shard row blocks balanced by byte volume
+        (parallel.balance, LPT) so one fat image cannot serialize
+        the data axis; each block pads to the widest shard (rows of
+        ``seg_file == -1`` are inert — all-zero segments match no
+        literal and the decoders skip them). Row filling is bulk
+        strided copies, fanned over the host pool when the batch is
+        large enough to amortize it."""
+        from ..runtime.hostpool import map_in_pool
         step = self.seg_len - self.overlap
-        for fe in files:
-            n = len(fe.content)
-            if n == 0:
-                continue
-            pos = 0
-            while True:
-                chunks.append(fe.content[pos:pos + self.seg_len])
-                seg_file.append(fe.index)
-                seg_pos.append(pos)
-                if pos + self.seg_len >= n:
-                    break
-                pos += step
-        if not chunks:
-            return (np.zeros((0, self.seg_len), np.uint8), [], [])
-        buf = np.zeros((len(chunks), self.seg_len), np.uint8)
-        for i, c in enumerate(chunks):
-            buf[i, :len(c)] = np.frombuffer(c, np.uint8)
-        return buf, seg_file, seg_pos
+        metas = [(fe, len(fe.content), self._n_segs(len(fe.content)))
+                 for fe in files if len(fe.content) > 0]
+        if not metas:
+            return (np.zeros((0, self.seg_len), np.uint8), [], [],
+                    [])
+
+        n_shards = 1
+        if self.mesh is not None:
+            from ..parallel.mesh import mesh_axis_sizes
+            n_shards = mesh_axis_sizes(self.mesh)[0]
+        occupancy: list = []
+        if n_shards > 1 and len(metas) > 1:
+            from ..parallel.balance import (balance_by_volume,
+                                            shard_occupancy)
+            volumes = [n for _, n, _ in metas]
+            assign = balance_by_volume(volumes, n_shards)
+            occupancy = shard_occupancy(volumes, assign, n_shards)
+            by_shard: list = [[] for _ in range(n_shards)]
+            for mi, s in enumerate(assign):
+                by_shard[s].append(mi)
+            rows_per_shard = max(
+                sum(metas[mi][2] for mi in block) or 1
+                for block in by_shard)
+            # align the block size with the jit shape bucket:
+            # run_blockmask pads B to _bucket(B) BEFORE the mesh
+            # splits it into equal contiguous chunks, so unless the
+            # bucket lands exactly on n_shards blocks the appended
+            # padding would shift every shard boundary and hand the
+            # last devices mostly zeros — the exact skew this layout
+            # exists to remove
+            from ..ops.keywords import _bucket
+            bucketed = _bucket(n_shards * rows_per_shard)
+            if bucketed % n_shards == 0:
+                rows_per_shard = bucketed // n_shards
+            B = n_shards * rows_per_shard
+            layout = []          # (row0, meta index)
+            for s, block in enumerate(by_shard):
+                row = s * rows_per_shard
+                for mi in block:
+                    layout.append((row, mi))
+                    row += metas[mi][2]
+        else:
+            B = sum(m[2] for m in metas)
+            layout, row = [], 0
+            for mi, m in enumerate(metas):
+                layout.append((row, mi))
+                row += m[2]
+
+        buf = np.zeros((B, self.seg_len), np.uint8)
+        seg_file = [-1] * B
+        seg_pos = [0] * B
+        for row0, mi in layout:
+            fe, _n, n_segs = metas[mi]
+            for k in range(n_segs):
+                seg_file[row0 + k] = fe.index
+                seg_pos[row0 + k] = k * step
+
+        def fill(task) -> None:
+            row0, mi = task
+            fe, _n, n_segs = metas[mi]
+            self._fill_rows(buf, row0, fe.content, n_segs)
+
+        map_in_pool(fill, layout)
+        return buf, seg_file, seg_pos, occupancy
 
     # --- the public API ---
 
@@ -175,8 +258,10 @@ class BatchSecretScanner:
             "rules_wholefile": wholefile,
             "files_with_findings": len(results),
             "sieve_s": round(sieve_s, 4),
+            "pack_s": round(handle.get("pack_s", 0.0), 4),
             "device_s": round(handle["device_s"], 4),
             "verify_s": round(verify_s, 4),
+            "shard_occupancy": handle.get("shard_occupancy", []),
         }
         return results
 
@@ -187,9 +272,17 @@ class BatchSecretScanner:
         consumes; on the fused path the jax arrays inside are NOT yet
         materialized — the device computes in the background."""
         import time as _time
-        buf, seg_file, seg_pos = self._segment(entries)
+
+        from ..obs.trace import phase_span
+        t0 = _time.perf_counter()
+        with phase_span("pack", files=len(entries)) as sp:
+            buf, seg_file, seg_pos, occupancy = \
+                self._segment(entries)
+            sp.set("segments", int(buf.shape[0]))
+        pack_s = _time.perf_counter() - t0
         handle = {"entries": entries, "buf": buf, "device_s": 0.0,
-                  "seg_file": seg_file, "seg_pos": seg_pos}
+                  "seg_file": seg_file, "seg_pos": seg_pos,
+                  "pack_s": pack_s, "shard_occupancy": occupancy}
         if buf.shape[0] == 0:
             handle["mode"] = "empty"
             return handle
@@ -212,7 +305,8 @@ class BatchSecretScanner:
         key = (self.plan.table.literals,
                tuple(self.plan.run_specs),
                jax.default_backend())
-        dev = jax.device_put(pad_batch(buf))
+        with phase_span("h2d_upload", bytes=int(buf.nbytes)):
+            dev = jax.device_put(pad_batch(buf))
         nhit, idx, cm, h = make_fused_sieve(*key)(dev)
         handle.update(mode="fused", key=key, dev=dev, nhit=nhit,
                       idx=idx, cm=cm, h=h)
@@ -272,6 +366,8 @@ class BatchSecretScanner:
             if not runs_ready[0]:
                 if run_fetch is not None:
                     for si, sp in zip(*np.nonzero(run_fetch)):
+                        if seg_file[int(si)] < 0:
+                            continue      # shard-padding row
                         runs_cache.setdefault(
                             seg_file[int(si)], set()).add(int(sp))
                 else:
@@ -284,6 +380,8 @@ class BatchSecretScanner:
         file_codes: dict = {}
         for si, ci, mv in zip(seg_nz.tolist(), code_nz.tolist(),
                               hit_vals.tolist()):
+            if seg_file[si] < 0:
+                continue                  # shard-padding row
             fc = file_codes.setdefault(seg_file[si], {})
             fc.setdefault(ci, []).append((seg_pos[si], int(mv)))
 
@@ -354,6 +452,8 @@ class BatchSecretScanner:
         handle["device_s"] += _time.perf_counter() - t0
         out: dict = {}
         for si, sp in zip(*np.nonzero(hits)):
+            if seg_file[int(si)] < 0:
+                continue                  # shard-padding row
             out.setdefault(seg_file[int(si)], set()).add(int(sp))
         return out
 
