@@ -22,8 +22,10 @@ def test_sharded_blockmask_matches_host():
     from trivy_tpu.secret.scanner import new_scanner
     from trivy_tpu.secret.plan import build_scan_plan
 
+    # the ≤8-byte prefixes of the DFA plan's literal corpus, packed
+    # through the legacy shard_map code table (kept for this kernel)
     plan = build_scan_plan(new_scanner().rules)
-    t = plan.table
+    t = build_code_table(list(plan.table.literals))
     codes = _pad_codes((t.lo, t.hi, t.lo_mask, t.hi_mask))
     rng = np.random.default_rng(3)
     buf = rng.integers(32, 127, (37, 512)).astype(np.uint8)

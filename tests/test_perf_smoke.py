@@ -168,15 +168,18 @@ def test_bulk_segment_packing_matches_naive():
 def test_balanced_shard_layout_sound(mesh8):
     """Mesh layout: every file's segments land contiguously inside
     one shard block, pad rows are marked -1 and zero-filled, and
-    the per-shard occupancy reflects the LPT balance."""
-    from trivy_tpu.parallel.mesh import mesh_axis_sizes
+    the per-shard occupancy reflects the LPT balance. The sieve
+    shards over every device of the mesh, flat (the DFA table is
+    replicated per chip, so the data axis gets all the parallelism)
+    — PROVIDED the batch is big enough to give each shard a full
+    ≥64-row block; the corpus below is."""
     from trivy_tpu.secret.batch import BatchSecretScanner, _FileEntry
     s = BatchSecretScanner(backend="cpu-ref", mesh=mesh8)
-    d = mesh_axis_sizes(mesh8)[0]
+    d = int(mesh8.devices.size)
     rng = np.random.default_rng(11)
-    # one fat file + many small ones — the case contiguous layout
-    # serializes
-    sizes = [40 * s.seg_len] + [s.seg_len // 2] * 15
+    # one fat file + many mid-size ones — the case contiguous layout
+    # serializes (≈ 40 + 30×10 segments → 8 shards of ≥ 64 rows)
+    sizes = [40 * s.seg_len] + [10 * s.seg_len] * 30
     entries = [_FileEntry(path=f"f{i}",
                           content=rng.integers(
                               32, 127, n).astype(np.uint8).tobytes(),
@@ -291,31 +294,47 @@ def test_nested_map_in_pool_runs_inline_no_deadlock(monkeypatch):
         pool.shutdown(wait=False)
 
 
-def test_mesh_segment_layout_survives_shape_bucketing(mesh8):
-    """The shard blocks must land exactly on the jit shape bucket:
-    run_blockmask pads B to _bucket(B) before the mesh splits it,
-    so B already being a bucket multiple of the data axis is what
-    keeps device boundaries aligned with the LPT blocks."""
+def test_mesh_segment_layout_matches_shape_bucket(mesh8):
+    """Shard count derives from the batch's PADDED size: the total
+    padded rows must equal the 1-device pad bucket at EVERY device
+    count (so adding virtual devices can never inflate sieve
+    compute — the 2× regression the first sharded-async cut hit),
+    shards are ≥64-row blocks, and a small batch simply uses fewer
+    shards instead of shattering into padded slivers."""
     from trivy_tpu.ops.keywords import _bucket
-    from trivy_tpu.parallel.mesh import mesh_axis_sizes
     from trivy_tpu.secret.batch import BatchSecretScanner, _FileEntry
     s = BatchSecretScanner(backend="cpu-ref", mesh=mesh8)
-    d = mesh_axis_sizes(mesh8)[0]
     rng = np.random.default_rng(13)
-    entries = [_FileEntry(path=f"f{i}",
-                          content=rng.integers(32, 127, 5 * s.seg_len)
-                          .astype(np.uint8).tobytes(),
-                          index=i)
-               for i in range(9)]
-    buf, seg_file, _pos, _occ = s._segment(entries)
-    B = buf.shape[0]
-    assert _bucket(B) == B          # pad_batch is a no-op on this B
-    assert B % d == 0
-    rows_per_shard = B // d
-    # every file still sits inside one post-bucket device chunk
-    for e in entries:
-        rows = [r for r in range(B) if seg_file[r] == e.index]
-        assert rows[0] // rows_per_shard == rows[-1] // rows_per_shard
+
+    def layout_for(n_files):
+        entries = [_FileEntry(path=f"f{i}",
+                              content=rng.integers(
+                                  32, 127, 5 * s.seg_len)
+                              .astype(np.uint8).tobytes(),
+                              index=i)
+                   for i in range(n_files)]
+        return s._layout(s._metas(entries))
+
+    # small batch (9 files ≈ 54 segs): bucket 256 → 4 shards of 64,
+    # NOT 8 shards of padded slivers
+    lay = layout_for(9)
+    total = sum(1 for f in lay["seg_file"] if f >= 0)
+    assert lay["B"] == _bucket(total)
+    assert lay["n_shards"] == 4
+    assert lay["rows_per_shard"] == 64
+
+    # big batch (60 files ≈ 360 segs): bucket 512 → all 8 shards
+    lay = layout_for(60)
+    assert lay["n_shards"] == 8
+    assert lay["B"] == lay["n_shards"] * lay["rows_per_shard"]
+    assert lay["rows_per_shard"] % 64 == 0
+    # every file sits inside one shard block
+    rps = lay["rows_per_shard"]
+    seg_file = lay["seg_file"]
+    for idx in set(f for f in seg_file if f >= 0):
+        rows = [r for r in range(lay["B"]) if seg_file[r] == idx]
+        assert rows == list(range(rows[0], rows[0] + len(rows)))
+        assert rows[0] // rps == rows[-1] // rps
 
 
 def test_detect_metrics_on_metrics_surface():

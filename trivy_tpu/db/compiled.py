@@ -129,16 +129,116 @@ _GENERATION_SEQ = [0]
 
 
 def _next_generation() -> int:
-    """Process-monotonic DB generation key: every compile/load gets
-    a fresh one, so device buffers, caches and metrics can tell "the
-    same tables again" from "a hot-swapped update" without hashing
-    gigabytes (docs/performance.md)."""
+    """Process-monotonic table generation key: every compile/load
+    gets a fresh one, so device buffers, caches and metrics can tell
+    "the same tables again" from "a hot-swapped update" without
+    hashing gigabytes (docs/performance.md). Shared by the advisory
+    DB and the secret DFA table (ops/dfa.py) — one namespace means
+    one invalidation story."""
     with _GENERATION_LOCK:
         _GENERATION_SEQ[0] += 1
         return _GENERATION_SEQ[0]
 
 
-class CompiledDB:
+class ResidentTables:
+    """Device-residency plumbing shared by every table that lives in
+    HBM across dispatches: the compiled advisory DB below and the
+    secret scanner's DFA table (trivy_tpu.ops.dfa).
+
+    Contract: ``device_tables(placement)`` stages the arrays from
+    ``_resident_arrays()`` ONCE per (generation, placement) and
+    hands back the same device buffers on every later call;
+    ``invalidate_device()`` drops them on hot swap (in-flight
+    dispatches keep their references until they finish — jax frees
+    the HBM when the last one drops). ``placement`` is None (default
+    device), a ``jax.sharding.Mesh`` (replicated to every chip), or
+    a single ``jax.Device`` (the async sharded sieve places the DFA
+    table per data shard). Upload/dispatch amortization is counted
+    in ``device_stats()`` and mirrored to the subclass's metrics via
+    the ``_note_*`` hooks."""
+
+    _UPLOAD_SPAN = "db_upload"
+
+    def _init_resident(self) -> None:
+        self.generation = _next_generation()
+        self._device: dict = {}
+        self._device_lock = threading.Lock()
+        self._device_stats = {"uploads": 0, "upload_bytes": 0,
+                              "dispatches": 0, "invalidations": 0}
+
+    # --- subclass hooks ---
+
+    def _resident_arrays(self) -> tuple:
+        raise NotImplementedError
+
+    def _span_attrs(self) -> dict:
+        return {}
+
+    def _note_upload(self, nbytes: int) -> None:
+        pass
+
+    def _note_dispatch(self) -> None:
+        pass
+
+    def _note_invalidation(self) -> None:
+        pass
+
+    # --- the shared machinery ---
+
+    def device_tables(self, placement=None) -> tuple:
+        import jax
+
+        from ..obs.trace import phase_span
+        key = "default" if placement is None else placement
+        with self._device_lock:
+            placed = self._device.get(key)
+            if placed is None:
+                arrs = self._resident_arrays()
+                nbytes = int(sum(a.nbytes for a in arrs))
+                with phase_span(self._UPLOAD_SPAN, bytes=nbytes,
+                                generation=self.generation,
+                                **self._span_attrs()):
+                    if placement is None:
+                        placed = tuple(jax.device_put(a)
+                                       for a in arrs)
+                    elif hasattr(placement, "devices"):   # a Mesh
+                        from ..parallel.interval_shard import \
+                            replicate_tables
+                        placed = replicate_tables(placement, arrs)
+                    else:                          # a single Device
+                        placed = tuple(
+                            jax.device_put(a, placement)
+                            for a in arrs)
+                self._device[key] = placed
+                self._device_stats["uploads"] += 1
+                self._device_stats["upload_bytes"] += nbytes
+                self._note_upload(nbytes)
+            self._device_stats["dispatches"] += 1
+        self._note_dispatch()
+        return placed
+
+    def invalidate_device(self) -> None:
+        """Drop this generation's device buffers (hot-swap path)."""
+        with self._device_lock:
+            if not self._device:
+                return
+            self._device.clear()
+            self._device_stats["invalidations"] += 1
+        self._note_invalidation()
+
+    def device_stats(self) -> dict:
+        """Upload-amortization numbers for bench/metrics: how many
+        dispatches each HBM upload served."""
+        with self._device_lock:
+            out = dict(self._device_stats)
+        out["generation"] = self.generation
+        out["amortization"] = round(
+            out["dispatches"] / out["uploads"], 2) \
+            if out["uploads"] else 0.0
+        return out
+
+
+class CompiledDB(ResidentTables):
     """Flattened advisory tables + join index. Read-only after
     ``compile`` / ``load``."""
 
@@ -152,11 +252,7 @@ class CompiledDB:
         self.vulnerabilities: dict = {}
         self.data_sources: dict = {}
         self.stats: dict = {}
-        self.generation = _next_generation()
-        self._device: dict = {}
-        self._device_lock = threading.Lock()
-        self._device_stats = {"uploads": 0, "upload_bytes": 0,
-                              "dispatches": 0, "invalidations": 0}
+        self._init_resident()
         self._parse_cache: dict = {}
 
     # ---- compile ----
@@ -395,67 +491,35 @@ class CompiledDB:
         except ValueError:
             return False
 
-    # ---- device residency ----
+    # ---- device residency (ResidentTables hooks) ----
+    #
+    # device_tables(mesh) pushes (v_lo, v_hi, s_lo, s_hi, flags) to
+    # the default device (or replicated across the mesh) ONCE per
+    # (generation, placement); invalidate_device (hot-swap / ``trivy
+    # db update``) drops the buffers so the superseded generation's
+    # HBM is reclaimed as soon as its last reader finishes.
 
-    def device_tables(self, mesh=None):
-        """Push tables to the default device (or replicated across a
-        mesh) ONCE per (generation, mesh); every later dispatch keys
-        against the resident buffers instead of re-transferring the
-        advisory operands. Returns (v_lo, v_hi, s_lo, s_hi, flags)
-        device arrays. ``invalidate_device`` (hot-swap / ``trivy db
-        update``) drops the buffers so the superseded generation's
-        HBM is reclaimed as soon as its last reader finishes."""
-        import jax
+    def device_tables(self, mesh=None) -> tuple:
+        return super().device_tables(mesh)
 
+    def _resident_arrays(self) -> tuple:
+        return (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
+                self.flags)
+
+    def _span_attrs(self) -> dict:
+        return {"rows": int(len(self.flags))}
+
+    def _note_upload(self, nbytes: int) -> None:
         from ..detect.metrics import DETECT_METRICS
-        from ..obs.trace import phase_span
-        key = "default" if mesh is None else mesh
-        with self._device_lock:
-            placed = self._device.get(key)
-            if placed is None:
-                arrs = (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
-                        self.flags)
-                nbytes = int(sum(a.nbytes for a in arrs))
-                with phase_span("db_upload", bytes=nbytes,
-                                generation=self.generation,
-                                rows=int(len(self.flags))):
-                    if mesh is None:
-                        placed = tuple(jax.device_put(a)
-                                       for a in arrs)
-                    else:
-                        from ..parallel.interval_shard import \
-                            replicate_tables
-                        placed = replicate_tables(mesh, arrs)
-                self._device[key] = placed
-                self._device_stats["uploads"] += 1
-                self._device_stats["upload_bytes"] += nbytes
-                DETECT_METRICS.note_db_upload(nbytes)
-            self._device_stats["dispatches"] += 1
+        DETECT_METRICS.note_db_upload(nbytes)
+
+    def _note_dispatch(self) -> None:
+        from ..detect.metrics import DETECT_METRICS
         DETECT_METRICS.inc("resident_dispatches")
-        return placed
 
-    def invalidate_device(self) -> None:
-        """Drop this generation's device buffers (DB update path).
-        In-flight dispatches keep their references alive until they
-        finish; jax frees the HBM when the last one drops."""
+    def _note_invalidation(self) -> None:
         from ..detect.metrics import DETECT_METRICS
-        with self._device_lock:
-            if not self._device:
-                return
-            self._device.clear()
-            self._device_stats["invalidations"] += 1
         DETECT_METRICS.inc("db_invalidations")
-
-    def device_stats(self) -> dict:
-        """Upload-amortization numbers for bench/metrics: how many
-        dispatches each HBM upload served."""
-        with self._device_lock:
-            out = dict(self._device_stats)
-        out["generation"] = self.generation
-        out["amortization"] = round(
-            out["dispatches"] / out["uploads"], 2) \
-            if out["uploads"] else 0.0
-        return out
 
     # ---- enrichment reads (db.Config parity) ----
 

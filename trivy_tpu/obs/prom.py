@@ -183,6 +183,39 @@ def render_prometheus(stats: dict, phase_hists=None,
                  "Resident-table dispatches served per HBM upload.",
                  detect.get("upload_amortization"))
 
+    secret = stats.get("secret") or {}
+    if secret:
+        name = f"{_PREFIX}_secret_events_total"
+        w.header(name, "counter",
+                 "Secret-sieve counters (files gated on-device vs "
+                 "host verify, chain-gated rules, DFA uploads, "
+                 "shard/decode tasks).")
+        for k in sorted(secret):
+            if k.endswith(("_s", "_selectivity", "amortization")) \
+                    or k == "dfa_upload_bytes":
+                continue     # derived gauges / seconds / bytes below
+            w.sample(name, [("event", k)], secret[k])
+        w.scalar(f"{_PREFIX}_secret_sieve_selectivity", "gauge",
+                 "Share of scanned files that needed ANY host "
+                 "verification (files_gated / files_total).",
+                 secret.get("sieve_selectivity"))
+        w.scalar(f"{_PREFIX}_secret_sieve_seconds_total", "counter",
+                 "Cumulative wall seconds in the sieve "
+                 "(pack + dispatch + decode).",
+                 secret.get("sieve_s"))
+        w.scalar(f"{_PREFIX}_secret_verify_tail_seconds_total",
+                 "counter",
+                 "Cumulative wall seconds in the CPU-exact verify "
+                 "tail.", secret.get("verify_s"))
+        w.scalar(f"{_PREFIX}_secret_dfa_upload_bytes_total",
+                 "counter",
+                 "Bytes of DFA band tables staged to HBM.",
+                 secret.get("dfa_upload_bytes"))
+        w.scalar(f"{_PREFIX}_secret_dfa_upload_amortization",
+                 "gauge",
+                 "DFA-table dispatches served per HBM upload.",
+                 secret.get("dfa_upload_amortization"))
+
     idem = stats.get("idempotency") or {}
     if idem:
         w.scalar(f"{_PREFIX}_idempotency_entries", "gauge",

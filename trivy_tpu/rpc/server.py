@@ -344,6 +344,11 @@ class ScanServer:
             # resident-DB upload amortization)
             from ..detect.metrics import DETECT_METRICS
             out["detect"] = DETECT_METRICS.snapshot()
+        if "secret" not in out:
+            # and the secret-sieve counters (selectivity, verify
+            # tail, DFA upload amortization)
+            from ..secret.metrics import SECRET_METRICS
+            out["secret"] = SECRET_METRICS.snapshot()
         out["admission"] = {"max_body_bytes": self.max_body_bytes,
                             "max_scan_blobs": self.max_scan_blobs}
         breaker = getattr(self.cache, "breaker_stats", None)

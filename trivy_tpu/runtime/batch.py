@@ -589,10 +589,12 @@ class BatchScanRunner:
         # ---- phase 1: decode + blob (host, pooled) ----
         # decode is the dominant host phase at fleet scale (BENCH_r05:
         # 4.2s of the 7.99s SBOM bench): json parse + purl decode per
-        # component. The host pool spreads per-document decodes over
-        # the spare cores; repeated purl strings short-circuit in the
-        # purl parse cache (docs/performance.md). A malformed
-        # document still fails only its own slot.
+        # component. The host pool spreads document decodes over the
+        # spare cores in ≥64-doc slabs — per-doc tasks made pool
+        # dispatch overhead the visible cost in the hostpool stats —
+        # and repeated purl strings short-circuit in the purl parse
+        # cache (docs/performance.md). A malformed document still
+        # fails only its own slot.
         from .hostpool import map_in_pool
         t0 = _time.perf_counter()
         scanner = LocalScanner(self.cache, self.store)
@@ -604,7 +606,7 @@ class BatchScanRunner:
             except ValueError as e:
                 return e
 
-        decodes = map_in_pool(decode_one, list(boms))
+        decodes = map_in_pool(decode_one, list(boms), chunk=64)
         prepared, metas, failures = [], [], {}
         for i, ((name, _data), dec) in enumerate(zip(boms,
                                                      decodes)):

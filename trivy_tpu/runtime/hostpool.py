@@ -56,7 +56,7 @@ def get_host_pool():
     return _POOL
 
 
-def map_in_pool(fn, items: list) -> list:
+def map_in_pool(fn, items: list, chunk: int = 1) -> list:
     """``[fn(x) for x in items]`` spread over the pool (input order
     preserved). Falls back to the inline loop when the pool is
     disabled, the batch is too small to amortize the hops, or the
@@ -64,13 +64,29 @@ def map_in_pool(fn, items: list) -> list:
     ``pool.map`` of its own pool deadlocks the moment every worker
     is such a task (the direct path's sieve enqueue runs here and
     then packs segments through here again). ``fn`` must capture
-    its own errors — a raising task would abandon the batch."""
+    its own errors — a raising task would abandon the batch.
+
+    ``chunk > 1`` batches that many items per pool task. Per-item
+    submission made task-dispatch overhead the visible cost of the
+    10k-document SBOM decode (BENCH_r05 ``decode_s``): a worker did
+    ~0.4 ms of json parsing per ~hop. Decode callers pass 64 so
+    every hop amortizes over a real slab of work."""
     from ..detect.metrics import DETECT_METRICS
     on_pool_thread = threading.current_thread().name.startswith(
         "trivy-hostpool")
     pool = get_host_pool() \
-        if len(items) > 8 and not on_pool_thread else None
+        if len(items) > max(8, chunk) and not on_pool_thread \
+        else None
     if pool is None:
         return [fn(x) for x in items]
+    if chunk > 1:
+        slabs = [items[i:i + chunk]
+                 for i in range(0, len(items), chunk)]
+        DETECT_METRICS.inc("pack_tasks", len(slabs))
+        out: list = []
+        for part in pool.map(lambda slab: [fn(x) for x in slab],
+                             slabs):
+            out.extend(part)
+        return out
     DETECT_METRICS.inc("pack_tasks", len(items))
     return list(pool.map(fn, items))

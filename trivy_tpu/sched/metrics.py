@@ -254,4 +254,9 @@ class SchedMetrics:
         # amortization — process-wide, like the guard totals
         from ..detect.metrics import DETECT_METRICS
         out["detect"] = DETECT_METRICS.snapshot()
+        # secret-sieve counters (docs/performance.md "DFA engine"):
+        # selectivity, verify tail, on-device vs host-fallback file
+        # counts, DFA table upload amortization
+        from ..secret.metrics import SECRET_METRICS
+        out["secret"] = SECRET_METRICS.snapshot()
         return out

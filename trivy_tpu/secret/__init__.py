@@ -1,9 +1,11 @@
 """Secret detection engine.
 
 CPU path: exact reference semantics (pkg/fanal/secret/scanner.go).
-TPU path: literal/anchor blockmask sieve (trivy_tpu.ops.keywords) +
+TPU path: multi-pattern DFA sieve (trivy_tpu.ops.dfa — full-length
+keywords, anchors, and per-rule fixed chains in one banded table) +
 class-run gates (trivy_tpu.ops.runs) + sparse host verification,
-orchestrated by trivy_tpu.secret.batch.
+orchestrated by trivy_tpu.secret.batch (sharded async over a mesh —
+trivy_tpu.parallel.secret_shard).
 """
 
 from .model import (

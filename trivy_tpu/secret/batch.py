@@ -1,26 +1,35 @@
-"""Batched secret scanning: TPU literal sieve + windowed host verify.
+"""Batched secret scanning: on-device multi-pattern DFA sieve +
+windowed host verify.
 
 Pipeline (the TPU re-design of the reference's per-file scan loop,
 pkg/fanal/secret/scanner.go:341):
 
   1. files → fixed-size overlapping segments in one [B, L] uint8 buffer
      (the "sequence dimension" of this domain — SURVEY.md §5);
-  2. ONE kernel dispatch matches every gate keyword + anchor literal
-     over every segment (trivy_tpu.ops.keywords), returning per-segment
-     position bitmasks — pure elementwise work, no gathers;
-  3. a second elementwise kernel over the same buffer detects mandatory
-     class-runs (trivy_tpu.ops.runs) — rules the window proof rejects
-     but whose regex provably requires, say, 40 consecutive base64
-     bytes (aws-secret-access-key) are gated out of the whole-file host
-     scan when no such run exists anywhere in the file;
-  4. host decodes hits: a rule is *gated in* for a file iff one of its
-     keywords hit (reference MatchKeywords semantics); for rules whose
-     regex is provably anchor-bounded (rx.anchor), a preliminary regex
-     over small windows around anchor hits decides whether the rule can
-     match at all;
-  5. files with surviving rules get a CPU-exact scan restricted to
+  2. ONE kernel dispatch scans every segment against the compiled
+     multi-pattern table (trivy_tpu.ops.dfa): full-length gate
+     keywords, anchor literals, and each rule's mandatory fixed
+     byte-class chain — per-(segment, pattern) position bitmasks out
+     of a banded transition table resident in HBM. Class-run gates
+     (trivy_tpu.ops.runs) ride the same dispatch;
+  3. host decodes hits: a rule is *gated in* for a file iff one of its
+     keywords hit (reference MatchKeywords semantics) AND its compiled
+     chain hit (a chain miss is a PROOF the regex cannot match — the
+     rule resolves fully on-device); for rules whose regex is provably
+     anchor-bounded (rx.anchor), a preliminary regex over small
+     windows around anchor hits decides whether the rule can match;
+  4. files with surviving rules get a CPU-exact scan restricted to
      those rules — byte-identical findings, because every rule that
      could contribute findings (or censoring) survives the sieve.
+
+With a mesh, the sieve is submitted SHARDED AND ASYNC
+(parallel/secret_shard.py): per-shard segment packing fans over the
+host pool concurrently, ONE non-blocking shard_map dispatch splits
+the rows across every chip (so the sieve computes while the caller
+squashes layers, preps interval jobs, and packs the next batch), and
+per-shard result decode fans back over the pool — the host thread
+never serializes the whole sieve, which is what used to make
+``secret_batch_s`` GROW with device count (BENCH_r05).
 """
 
 from __future__ import annotations
@@ -30,8 +39,7 @@ from typing import Iterable, Optional
 
 import numpy as np
 
-from ..ops.keywords import (MAX_CODE_LEN, N_BLOCKS, pad_batch,
-                            run_blockmask)
+from ..ops.keywords import MAX_CODE_LEN, N_BLOCKS, pad_batch
 from ..utils import get_logger
 from .plan import ScanPlan, build_scan_plan
 from .scanner import Scanner
@@ -39,7 +47,7 @@ from .scanner import Scanner
 log = get_logger("secret.batch")
 
 SEG_LEN = 2048       # segment length in bytes
-OVERLAP = 16         # ≥ MAX_CODE_LEN so no literal straddles uncovered
+OVERLAP = 16         # floor; raised to the plan's min_overlap
 
 
 @dataclass
@@ -63,13 +71,17 @@ class BatchSecretScanner:
         self.backend = backend
         self.mesh = mesh
         self.plan: ScanPlan = build_scan_plan(self.scanner.rules)
-        # overlap ≥ max run length so a straddling class-run appears
-        # whole in at least one segment (ops/runs.py soundness)
+        self.table = self.plan.table
+        # overlap ≥ the longest compiled pattern (full-length
+        # keywords, chains, class runs) so nothing straddles an
+        # uncovered segment boundary — plan.validate_overlap makes a
+        # violation a loud build error, not a silent false negative
         self.overlap = max(OVERLAP, MAX_CODE_LEN,
-                           self.plan.max_runlen)
+                           self.plan.min_overlap)
         # kernels need L % 128 == 0 (lane width / block reduction)
         self.seg_len = max(seg_len, 4 * self.overlap, 128)
         self.seg_len = ((self.seg_len + 127) // 128) * 128
+        self.plan.validate_overlap(self.overlap)
         self.stats: dict = {}
 
     # --- segmenting ---
@@ -81,6 +93,14 @@ class BatchSecretScanner:
         if n <= L:
             return 1
         return 1 + -(-(n - L) // step)
+
+    def _shard_count(self) -> int:
+        """Data shards for the sieve: every device of the mesh, flat
+        — the DFA table is KBs, so rules-axis sharding buys nothing;
+        each chip holds the full table and takes a slice of files."""
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.devices.size)
 
     def _fill_rows(self, buf: np.ndarray, row0: int, content: bytes,
                    n_segs: int) -> None:
@@ -103,33 +123,37 @@ class BatchSecretScanner:
             tmp, (n_segs, L), (step, 1))
         buf[row0:row0 + n_segs] = view
 
-    def _segment(self, files: list) -> tuple:
-        """Flatten files into [B, L] uint8 with per-file overlap
-        chaining. Returns (buffer, seg_file, seg_pos,
-        shard_occupancy).
-
-        Layout is the device assignment: with a mesh, files are
-        placed into per-shard row blocks balanced by byte volume
-        (parallel.balance, LPT) so one fat image cannot serialize
-        the data axis; each block pads to the widest shard (rows of
-        ``seg_file == -1`` are inert — all-zero segments match no
-        literal and the decoders skip them). Row filling is bulk
-        strided copies, fanned over the host pool when the batch is
-        large enough to amortize it."""
-        from ..runtime.hostpool import map_in_pool
+    def _layout(self, metas: list) -> dict:
+        """Row layout for a batch — the device assignment. With a
+        mesh, files are placed into per-shard row blocks balanced by
+        byte volume (parallel.balance, LPT) so one fat image cannot
+        serialize the data axis; each block pads to the widest shard
+        (rows of ``seg_file == -1`` are inert — all-zero segments
+        match no pattern and the decoders skip them). Returns
+        {B, layout: [(row0, meta idx)], seg_file, seg_pos,
+        occupancy, n_shards, rows_per_shard}."""
         step = self.seg_len - self.overlap
-        metas = [(fe, len(fe.content), self._n_segs(len(fe.content)))
-                 for fe in files if len(fe.content) > 0]
-        if not metas:
-            return (np.zeros((0, self.seg_len), np.uint8), [], [],
-                    [])
-
-        n_shards = 1
-        if self.mesh is not None:
-            from ..parallel.mesh import mesh_axis_sizes
-            n_shards = mesh_axis_sizes(self.mesh)[0]
+        n_shards = self._shard_count()
         occupancy: list = []
+        total = sum(m[2] for m in metas)
+        # shard count derives from the batch's PADDED size, not the
+        # device count alone: the jit pad ladder (_bucket) fixes the
+        # total padded rows, and shards are carved out of that same
+        # total in ≥ MIN_SHARD_ROWS blocks — so a small batch on 8
+        # devices uses fewer shards instead of padding every tiny
+        # shard up to a full block (measured 2× sieve inflation on
+        # the mesh bench's ~250-segment scheduler batches)
+        from ..ops.keywords import _bucket
+        MIN_SHARD_ROWS = 64          # = the pallas tile (TILE_B)
         if n_shards > 1 and len(metas) > 1:
+            Bp = _bucket(total, base=4 * MIN_SHARD_ROWS)
+            pow2 = 1
+            while pow2 * 2 <= n_shards:
+                pow2 *= 2
+            n_shards = max(1, min(pow2, Bp // MIN_SHARD_ROWS))
+        else:
+            n_shards = 1         # a single file cannot shard
+        if n_shards > 1:
             from ..parallel.balance import (balance_by_volume,
                                             shard_occupancy)
             volumes = [n for _, n, _ in metas]
@@ -138,20 +162,17 @@ class BatchSecretScanner:
             by_shard: list = [[] for _ in range(n_shards)]
             for mi, s in enumerate(assign):
                 by_shard[s].append(mi)
-            rows_per_shard = max(
-                sum(metas[mi][2] for mi in block) or 1
-                for block in by_shard)
-            # align the block size with the jit shape bucket:
-            # run_blockmask pads B to _bucket(B) BEFORE the mesh
-            # splits it into equal contiguous chunks, so unless the
-            # bucket lands exactly on n_shards blocks the appended
-            # padding would shift every shard boundary and hand the
-            # last devices mostly zeros — the exact skew this layout
-            # exists to remove
-            from ..ops.keywords import _bucket
-            bucketed = _bucket(n_shards * rows_per_shard)
-            if bucketed % n_shards == 0:
-                rows_per_shard = bucketed // n_shards
+            # every ladder value divides evenly by a pow2 shard
+            # count ≤ Bp/MIN_SHARD_ROWS, so the total padded rows
+            # are IDENTICAL at every device count; only a fat file
+            # overflowing its LPT block (occupancy shows it) can
+            # force a wider shard
+            rows_per_shard = Bp // n_shards
+            nat = max(sum(metas[mi][2] for mi in block) or 1
+                      for block in by_shard)
+            if nat > rows_per_shard:
+                rows_per_shard = -(-nat // MIN_SHARD_ROWS) * \
+                    MIN_SHARD_ROWS
             B = n_shards * rows_per_shard
             layout = []          # (row0, meta index)
             for s, block in enumerate(by_shard):
@@ -160,13 +181,13 @@ class BatchSecretScanner:
                     layout.append((row, mi))
                     row += metas[mi][2]
         else:
-            B = sum(m[2] for m in metas)
+            B = total
             layout, row = [], 0
             for mi, m in enumerate(metas):
                 layout.append((row, mi))
                 row += m[2]
+            n_shards, rows_per_shard = 1, B
 
-        buf = np.zeros((B, self.seg_len), np.uint8)
         seg_file = [-1] * B
         seg_pos = [0] * B
         for row0, mi in layout:
@@ -174,14 +195,38 @@ class BatchSecretScanner:
             for k in range(n_segs):
                 seg_file[row0 + k] = fe.index
                 seg_pos[row0 + k] = k * step
+        return {"B": B, "layout": layout, "seg_file": seg_file,
+                "seg_pos": seg_pos, "occupancy": occupancy,
+                "n_shards": n_shards,
+                "rows_per_shard": rows_per_shard}
+
+    def _metas(self, files: list) -> list:
+        return [(fe, len(fe.content), self._n_segs(len(fe.content)))
+                for fe in files if len(fe.content) > 0]
+
+    def _segment(self, files: list) -> tuple:
+        """Flatten files into [B, L] uint8 with per-file overlap
+        chaining. Returns (buffer, seg_file, seg_pos,
+        shard_occupancy). Row filling is bulk strided copies, fanned
+        over the host pool when the batch is large enough to
+        amortize it. (The sharded-async path packs per shard instead
+        — parallel.secret_shard.)"""
+        from ..runtime.hostpool import map_in_pool
+        metas = self._metas(files)
+        if not metas:
+            return (np.zeros((0, self.seg_len), np.uint8), [], [],
+                    [])
+        lay = self._layout(metas)
+        buf = np.zeros((lay["B"], self.seg_len), np.uint8)
 
         def fill(task) -> None:
             row0, mi = task
             fe, _n, n_segs = metas[mi]
             self._fill_rows(buf, row0, fe.content, n_segs)
 
-        map_in_pool(fill, layout)
-        return buf, seg_file, seg_pos, occupancy
+        map_in_pool(fill, lay["layout"])
+        return (buf, lay["seg_file"], lay["seg_pos"],
+                lay["occupancy"])
 
     # --- the public API ---
 
@@ -203,8 +248,9 @@ class BatchSecretScanner:
         device computes while the caller does host work (squash,
         interval job prep); ``collect`` fetches + verifies.
 
-        On the cpu-ref backend and the mesh path the dispatch runs
-        eagerly (those paths return host arrays already)."""
+        On the cpu-ref backend the dispatch runs eagerly; with a
+        mesh, per-shard packing fans over the host pool and one
+        non-blocking shard_map dispatch covers every chip."""
         import time as _time
         entries = [
             _FileEntry(path=p, content=c, index=i)
@@ -219,6 +265,8 @@ class BatchSecretScanner:
         """Blocking half of scan_files: fetch sieve outputs, decode
         candidates, run the windowed/whole-file exact verify."""
         import time as _time
+
+        from .metrics import SECRET_METRICS
         entries = handle["entries"]
         t0 = _time.perf_counter()
         candidates = self._decode(handle)
@@ -256,60 +304,82 @@ class BatchSecretScanner:
             "rules_verified": rules_verified,
             "rules_windowed": windowed,
             "rules_wholefile": wholefile,
+            "rules_chain_gated": handle.get("chain_gated", 0),
             "files_with_findings": len(results),
             "sieve_s": round(sieve_s, 4),
             "pack_s": round(handle.get("pack_s", 0.0), 4),
             "device_s": round(handle["device_s"], 4),
             "verify_s": round(verify_s, 4),
             "shard_occupancy": handle.get("shard_occupancy", []),
+            "mode": handle.get("mode", ""),
         }
+        SECRET_METRICS.note_batch(self.stats)
         return results
 
     # --- sieve stages ---
 
     def _dispatch(self, entries: list) -> dict:
         """Segment + enqueue the sieve. Returns the handle `_decode`
-        consumes; on the fused path the jax arrays inside are NOT yet
-        materialized — the device computes in the background."""
+        consumes; on the fused and sharded paths the jax arrays
+        inside are NOT yet materialized — the device(s) compute in
+        the background."""
         import time as _time
 
         from ..obs.trace import phase_span
+        handle = {"entries": entries, "device_s": 0.0}
+        if self.mesh is not None and self.backend != "cpu-ref":
+            # sharded async submission: concurrent per-shard packs
+            # on the host pool, one non-blocking mesh dispatch,
+            # decode fanned back over the pool at collect time
+            from ..parallel.secret_shard import ShardedSieve
+            metas = self._metas(entries)
+            if not metas:
+                handle["mode"] = "empty"
+                return handle
+            with phase_span("dfa_scan", files=len(entries),
+                            shards=self._shard_count()):
+                sharded = ShardedSieve(self, metas)
+                sharded.start()
+            handle.update(mode="sharded", sharded=sharded,
+                          shard_occupancy=sharded.occupancy)
+            return handle
+
         t0 = _time.perf_counter()
         with phase_span("pack", files=len(entries)) as sp:
             buf, seg_file, seg_pos, occupancy = \
                 self._segment(entries)
             sp.set("segments", int(buf.shape[0]))
         pack_s = _time.perf_counter() - t0
-        handle = {"entries": entries, "buf": buf, "device_s": 0.0,
-                  "seg_file": seg_file, "seg_pos": seg_pos,
-                  "pack_s": pack_s, "shard_occupancy": occupancy}
+        handle.update(buf=buf, seg_file=seg_file, seg_pos=seg_pos,
+                      pack_s=pack_s, shard_occupancy=occupancy)
         if buf.shape[0] == 0:
             handle["mode"] = "empty"
             return handle
-        if self.backend == "cpu-ref" or self.mesh is not None:
+        if self.backend == "cpu-ref":
             t0 = _time.perf_counter()
-            handle["masks"] = run_blockmask(
-                buf, self.plan.table, backend=self.backend,
-                mesh=self.mesh)
+            from ..ops.dfa import dfa_masks_host
+            handle["masks"] = dfa_masks_host(buf, self.table)
             handle["mode"] = "host"
             handle["device_s"] += _time.perf_counter() - t0
             return handle
         # fused path: the segment buffer crosses the tunnel ONCE,
-        # blockmask + run hits come out of a single dispatch on the
-        # resident copy, and the mask fetch is compacted to the hit
-        # rows (selectivity makes this ~1% of the full [B, K] array;
-        # the >CAP fallback fetches everything)
+        # pattern blockmasks + run hits come out of a single dispatch
+        # against the resident band table, and the mask fetch is
+        # compacted to the hit rows (selectivity makes this ~1% of
+        # the full [B, K] array; the >CAP fallback fetches all)
         import jax
-        from ..ops.keywords import make_fused_sieve
         t0 = _time.perf_counter()
-        key = (self.plan.table.literals,
-               tuple(self.plan.run_specs),
-               jax.default_backend())
+        platform = jax.default_backend()
+        specs = tuple(self.plan.run_specs)
+        tbl = self.table.device_tables()
+        fn = self.table.fused_sieve(specs, platform)
         with phase_span("h2d_upload", bytes=int(buf.nbytes)):
             dev = jax.device_put(pad_batch(buf))
-        nhit, idx, cm, h = make_fused_sieve(*key)(dev)
-        handle.update(mode="fused", key=key, dev=dev, nhit=nhit,
-                      idx=idx, cm=cm, h=h)
+        with phase_span("dfa_scan", segments=int(buf.shape[0]),
+                        patterns=self.table.n_patterns):
+            nhit, idx, cm, h = fn(dev, *tbl)
+        handle.update(mode="fused", platform=platform, dev=dev,
+                      tbl=tbl, nhit=nhit, idx=idx, cm=cm, h=h)
         handle["device_s"] += _time.perf_counter() - t0
         return handle
 
@@ -323,6 +393,20 @@ class BatchSecretScanner:
         if handle["mode"] == "empty":
             return {}
         entries = handle["entries"]
+
+        if handle["mode"] == "sharded":
+            t0 = _time.perf_counter()
+            file_codes, runs_map = handle["sharded"].decode()
+            handle["device_s"] += handle["sharded"].device_s
+            handle["pack_s"] = handle["sharded"].pack_s
+            handle["decode_s"] = _time.perf_counter() - t0
+
+            def file_runs(fidx) -> set:
+                return runs_map.get(fidx, set())
+
+            return self._choose(handle, entries, file_codes,
+                                file_runs)
+
         buf = handle["buf"]
         seg_file = handle["seg_file"]
         seg_pos = handle["seg_pos"]
@@ -334,16 +418,15 @@ class BatchSecretScanner:
             hit_vals = masks[seg_nz, code_nz]
         else:
             B = buf.shape[0]
-            K = self.plan.table.n_codes
+            K = self.table.n_patterns
             nhit = int(handle["nhit"])
             cm = handle["cm"]
             h = handle["h"]
             if nhit > min(cm.shape[0], handle["dev"].shape[0]):
                 # fetch the full mask array; run hits (h) were
                 # already computed by the fused dispatch
-                from ..ops.keywords import make_full_sieve
-                literals, _specs, platform = handle["key"]
-                m = make_full_sieve(literals, platform)(handle["dev"])
+                full = self.table.full_sieve((), handle["platform"])
+                m, _ = full(handle["dev"], *handle["tbl"])
                 masks = np.asarray(m)[:B, :K]
                 seg_nz, code_nz = np.nonzero(masks)
                 hit_vals = masks[seg_nz, code_nz]
@@ -376,7 +459,8 @@ class BatchSecretScanner:
                 runs_ready[0] = True
             return runs_cache.get(fidx, set())
 
-        # per file: code → merged list of (segment file-offset, bitmask)
+        # per file: pattern column → merged list of
+        # (segment file-offset, bitmask)
         file_codes: dict = {}
         for si, ci, mv in zip(seg_nz.tolist(), code_nz.tolist(),
                               hit_vals.tolist()):
@@ -385,9 +469,17 @@ class BatchSecretScanner:
             fc = file_codes.setdefault(seg_file[si], {})
             fc.setdefault(ci, []).append((seg_pos[si], int(mv)))
 
+        return self._choose(handle, entries, file_codes, file_runs)
+
+    def _choose(self, handle: dict, entries: list, file_codes: dict,
+                file_runs) -> dict:
+        """Rule selection over decoded pattern hits: keyword gate ∧
+        chain gate ∧ run gate ∧ (for anchored rules) anchor windows.
+        A chain miss resolves the rule on-device — no host regex."""
         by_index = {fe.index: fe for fe in entries}
         blk = self.seg_len // N_BLOCKS
         out: dict = {}
+        chain_gated = 0
 
         def runs_pass(rp, fidx) -> bool:
             return not rp.run_gate or \
@@ -395,13 +487,20 @@ class BatchSecretScanner:
 
         # rules with no keyword gate and no anchor run everywhere
         # (reference: empty keyword list passes MatchKeywords),
-        # unless a mandatory class-run is provably absent
+        # unless their DFA chain or a mandatory class-run is
+        # provably absent
         always = [rp for rp in self.plan.rules
                   if not rp.gate and not rp.anchored]
         if always:
             for fe in entries:
-                sel = {rp.rule_index: None for rp in always
-                       if runs_pass(rp, fe.index)}
+                codes = file_codes.get(fe.index, {})
+                sel = {}
+                for rp in always:
+                    if rp.chain is not None and rp.chain not in codes:
+                        chain_gated += 1
+                        continue
+                    if runs_pass(rp, fe.index):
+                        sel[rp.rule_index] = None
                 if sel:
                     out[fe.index] = sel
 
@@ -412,8 +511,12 @@ class BatchSecretScanner:
             for rp in self.plan.rules:
                 if rp.gate and not (hit & rp.gate):
                     continue
+                if rp.chain is not None and rp.chain not in hit:
+                    if rp.gate:
+                        chain_gated += 1
+                    continue
                 if not rp.anchored:
-                    if runs_pass(rp, fidx):
+                    if rp.gate and runs_pass(rp, fidx):
                         chosen[rp.rule_index] = None
                     continue
                 anchor_hits = [h for a in rp.anchors
@@ -429,6 +532,7 @@ class BatchSecretScanner:
                     chosen[rp.rule_index] = None
             if chosen:
                 out[fidx] = chosen
+        handle["chain_gated"] = chain_gated
         return out
 
     def _file_runs(self, buf: np.ndarray, seg_file: list,
@@ -445,7 +549,6 @@ class BatchSecretScanner:
         if self.backend == "cpu-ref":
             hits = run_hits_host(buf, specs)
         else:
-            from ..ops.keywords import pad_batch
             B = buf.shape[0]
             hits = np.asarray(
                 make_run_hits(specs)(pad_batch(buf)))[:B]
@@ -463,7 +566,7 @@ class BatchSecretScanner:
         match of the rule lies entirely inside one span, with ≥8 bytes
         of slack past any match edge (window = max match len, plus
         MAX_CODE_LEN for the anchor literal body crossing a block
-        edge)."""
+        edge — anchors are ≤ MAX_CODE_LEN by rx construction)."""
         w = rp.window + MAX_CODE_LEN
         spans = []
         for pos, mask in anchor_hits:
