@@ -876,7 +876,161 @@ def bench_serving() -> dict:
             "padding_waste": stats["batch"]["padding_waste"],
             "overlap_ratio": stats["overlap_ratio"],
             "queue_depth_max": stats["queue_depth_max"],
+            "adversarial_tenants": _adversarial_tenant_arm(
+                paths, store, max(2.0, 0.5 * batch_ips)),
         }
+
+
+# --- adversarial-tenant arm (docs/serving.md "Multi-tenant QoS") ---
+
+FLOOD_COMPLIANT = ("team0", "team1", "team2")
+N_ADVERSARIAL = 96          # compliant requests per arm
+FLOODER_RATE = 25.0         # the flooder's token-bucket budget
+FLOODER_MAX_QUEUED = 32
+FLOOD_P99_RATIO = 1.5       # compliant p99 bound vs flood-free
+FLOOD_P99_GRACE_S = 0.15    # absolute grace for shared-host noise
+
+
+def _adversarial_tenant_arm(paths: list, store,
+                            offered_ips: float) -> dict:
+    """The multi-tenant overload gate (ROADMAP item 3): three
+    compliant tenants offer the same Poisson traffic twice — once
+    flood-free (baseline), once while the seeded ``tenant-flood``
+    scenario's tenant submits an open-loop storm far over its
+    token-bucket budget. The tenancy layer (sched/tenant.py) must
+    shed the storm as 429 + Retry-After on the FLOODER (per-tenant
+    quota + rate limit), keep ZERO compliant requests rejected, and
+    hold compliant p99 within ``FLOOD_P99_RATIO`` of the baseline —
+    weighted fair queuing caps the flooder's service share, so its
+    admitted residue cannot starve anyone."""
+    import threading
+
+    from trivy_tpu.faults import parse_fault_spec
+    from trivy_tpu.runtime import BatchScanRunner
+    from trivy_tpu.sched import (QueueFullError, RateLimitedError,
+                                 TenancyConfig, TenantConfig)
+    from trivy_tpu.types import ScanOptions
+
+    spec = parse_fault_spec("tenant-flood")
+    flooder = spec.flood_tenant
+    tenancy = TenancyConfig(
+        tenants={flooder: TenantConfig(
+            name=flooder, weight=1.0, rate=FLOODER_RATE,
+            burst=FLOODER_RATE, max_queued=FLOODER_MAX_QUEUED)},
+        default=TenantConfig(weight=1.0))
+    options = ScanOptions(backend="tpu")
+    rng = np.random.default_rng(20260804)
+    gaps = rng.exponential(1.0 / offered_ips, N_ADVERSARIAL)
+
+    def run_arm(flood: bool) -> dict:
+        runner = BatchScanRunner(
+            store=store, backend="tpu",
+            sched=_sched_cfg(flush_timeout_s=0.05,
+                             eager_idle_flush=False,
+                             tenancy=tenancy))
+        client_shed = {"429": 0, "503": 0}
+        flood_reqs: list = []
+        stop = threading.Event()
+
+        def storm():
+            n = spec.flood_n or 256
+            gap = 1.0 / spec.flood_rate
+            for i in range(n):
+                if stop.is_set():
+                    break
+                try:
+                    flood_reqs.append(runner.submit_path(
+                        paths[i % len(paths)], options,
+                        tenant=flooder))
+                except RateLimitedError:
+                    client_shed["429"] += 1
+                except QueueFullError:
+                    client_shed["503"] += 1
+                time.sleep(gap)
+
+        t = None
+        if flood:
+            t = threading.Thread(target=storm, daemon=True)
+            t.start()
+        reqs = []
+        errors = 0
+        arrival = time.perf_counter()
+        for i, gap in enumerate(gaps):
+            arrival += gap
+            now = time.perf_counter()
+            if arrival > now:
+                time.sleep(arrival - now)
+            tenant = FLOOD_COMPLIANT[i % len(FLOOD_COMPLIANT)]
+            reqs.append(runner.submit_path(
+                paths[i % len(paths)], options, tenant=tenant))
+        for req in reqs:
+            if req.result().error:
+                errors += 1
+        if t is not None:
+            stop.set()
+            t.join(timeout=120)
+        for req in flood_reqs:
+            try:
+                req.result(timeout=120)
+            except Exception:       # noqa: BLE001 — the flooder's
+                pass                # own failures are its problem
+        tenants = runner.scheduler.stats()["tenants"]
+        runner.close()
+        assert not errors, \
+            f"{errors} compliant requests failed in the " \
+            f"{'flood' if flood else 'baseline'} arm"
+        out = {}
+        for name, snap in tenants.items():
+            c = snap["counters"]
+            offered = c["admitted"] + snap["shed"] \
+                + c["rejected_503"]
+            out[name] = {
+                "p50_s": snap["latency"]["p50_s"],
+                "p99_s": snap["latency"]["p99_s"],
+                "admitted": c["admitted"],
+                "shed": snap["shed"],
+                "rejected_503": c["rejected_503"],
+                "shed_rate": round(snap["shed"] / offered, 4)
+                if offered else 0.0,
+            }
+        out["_client_shed"] = dict(client_shed)
+        return out
+
+    base = run_arm(flood=False)
+    stormed = run_arm(flood=True)
+
+    # --- the gate ---
+    for name in FLOOD_COMPLIANT:
+        for arm, label in ((base, "baseline"), (stormed, "flood")):
+            snap = arm.get(name)
+            assert snap is not None, f"{name} missing in {label}"
+            assert snap["shed"] == 0 and \
+                snap["rejected_503"] == 0, \
+                f"compliant tenant {name} was rejected in the " \
+                f"{label} arm: {snap}"
+    fl = stormed.get(flooder)
+    assert fl is not None and fl["shed"] > 0, \
+        f"the flooder was never shed: {stormed}"
+    assert stormed["_client_shed"]["503"] == 0, \
+        f"flood spilled into global 503s: {stormed['_client_shed']}"
+    base_p99 = max(base[n]["p99_s"] for n in FLOOD_COMPLIANT)
+    flood_p99 = max(stormed[n]["p99_s"] for n in FLOOD_COMPLIANT)
+    assert flood_p99 <= FLOOD_P99_RATIO * base_p99 \
+        + FLOOD_P99_GRACE_S, \
+        f"compliant p99 did not hold under flood: " \
+        f"{flood_p99:.3f}s vs {base_p99:.3f}s flood-free " \
+        f"(bound {FLOOD_P99_RATIO}x + {FLOOD_P99_GRACE_S}s)"
+    return {
+        "baseline": base,
+        "flood": stormed,
+        "compliant_p99_base_s": round(base_p99, 4),
+        "compliant_p99_flood_s": round(flood_p99, 4),
+        "compliant_p99_ratio": round(
+            flood_p99 / base_p99, 3) if base_p99 else 0.0,
+        "flooder_shed": fl["shed"],
+        "flooder_shed_rate": fl["shed_rate"],
+        "flooder_admitted": fl["admitted"],
+    }
 
 
 N_FAULT_IMAGES = 64

@@ -586,7 +586,7 @@ class TestRpcPropagation:
         from trivy_tpu.types import ScanOptions
         sent = {}
 
-        def fake_call(self, path, body):
+        def fake_call(self, path, body, deadline_s=0.0):
             sent.update(body)
             return {"os": None, "results": []}
 

@@ -1,0 +1,683 @@
+"""Multi-tenant QoS tests (trivy_tpu.sched.tenant;
+docs/serving.md "Multi-tenant QoS"). The whole file carries the
+``tenant`` marker — ``pytest -m tenant`` is the fairness/overload
+smoke set; the metrics surface tests additionally carry ``obs``."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from trivy_tpu.sched import (AnalyzedWork, DeadlineExceeded,
+                             QueueFullError, RateLimitedError,
+                             ScanRequest, ScanScheduler, SchedConfig,
+                             SchedulerClosed, TenancyConfig,
+                             TenantConfig, TenantQueue, TokenBucket,
+                             parse_tenant_config)
+
+pytestmark = pytest.mark.tenant
+
+
+def _req(name="r", tenant="", priority=0, analyze=None):
+    return ScanRequest(name, analyze or (lambda r: None),
+                       tenant=tenant, priority=priority)
+
+
+# ---------------------------------------------------------------
+# unit: token bucket + config parsing
+# ---------------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_deny_then_refill(self):
+        b = TokenBucket(rate=10.0, burst=2.0)
+        assert b.take() == 0.0
+        assert b.take() == 0.0
+        wait = b.take()
+        assert 0.0 < wait <= 0.1 + 1e-6
+        time.sleep(wait + 0.02)
+        assert b.take() == 0.0
+
+    def test_default_burst_is_rate(self):
+        b = TokenBucket(rate=5.0)
+        for _ in range(5):
+            assert b.take() == 0.0
+        assert b.take() > 0.0
+
+
+class TestParseTenantConfig:
+    def test_inline_spec(self):
+        tc = parse_tenant_config(
+            "alice:weight=4,rate=100,burst=200,max_queued=64,"
+            "max_inflight=128;bob:weight=1;default:rate=50")
+        a = tc.tenants["alice"]
+        assert (a.weight, a.rate, a.burst) == (4.0, 100.0, 200.0)
+        assert (a.max_queued, a.max_inflight) == (64, 128)
+        assert tc.tenants["bob"].weight == 1.0
+        assert tc.default.rate == 50.0
+        # unknown tenants instantiate from the default template
+        assert tc.for_tenant("carol").rate == 50.0
+
+    def test_json_file(self, tmp_path):
+        p = tmp_path / "tenants.json"
+        p.write_text(json.dumps({
+            "alice": {"weight": 4, "rate": 100},
+            "default": {"max_queued": 8}}))
+        tc = parse_tenant_config(str(p))
+        assert tc.tenants["alice"].weight == 4.0
+        assert tc.default.max_queued == 8
+
+    def test_typos_fail_up_front(self, tmp_path):
+        with pytest.raises(ValueError):
+            parse_tenant_config("alice:wieght=4")
+        with pytest.raises(ValueError):
+            parse_tenant_config("alice:rate=abc")
+        with pytest.raises(ValueError):
+            parse_tenant_config("no-colon-entry")
+        p = tmp_path / "bad.json"
+        p.write_text("{not json")
+        with pytest.raises(ValueError):
+            parse_tenant_config(str(p))
+
+    def test_empty_is_single_anonymous_tenant(self):
+        tc = parse_tenant_config("")
+        assert tc.tenants == {}
+        cfg = tc.for_tenant("anyone")
+        assert cfg.rate == 0.0 and cfg.max_queued == 0
+
+
+# ---------------------------------------------------------------
+# unit: the WFQ queue — fairness, quotas, rate limits, priorities
+# ---------------------------------------------------------------
+
+class TestTenantQueue:
+    def test_service_share_converges_to_weights(self):
+        """Under backlog, pops are distributed in proportion to the
+        configured weights (stride scheduling property)."""
+        q = TenantQueue(1000, parse_tenant_config(
+            "a:weight=1;b:weight=2;c:weight=4"))
+        # enough backlog per tenant that nobody drains inside the
+        # measured window (an exhausted tenant correctly donates its
+        # share to the others — that would skew the measurement)
+        for i in range(100):
+            for t in ("a", "b", "c"):
+                q.put(_req(f"{t}{i}", tenant=t))
+        pops = [q.get(timeout=0).tenant for _ in range(140)]
+        share = {t: pops.count(t) / len(pops)
+                 for t in ("a", "b", "c")}
+        assert abs(share["a"] - 1 / 7) < 0.05, share
+        assert abs(share["b"] - 2 / 7) < 0.05, share
+        assert abs(share["c"] - 4 / 7) < 0.05, share
+
+    def test_single_tenant_is_fifo(self):
+        q = TenantQueue(100)
+        for i in range(10):
+            q.put(_req(f"r{i}"))
+        assert [q.get(timeout=0).name for _ in range(10)] == \
+            [f"r{i}" for i in range(10)]
+
+    def test_priority_classes_within_tenant(self):
+        q = TenantQueue(100)
+        q.put(_req("low1", priority=0))
+        q.put(_req("hi", priority=5))
+        q.put(_req("low2", priority=0))
+        assert [q.get(timeout=0).name for _ in range(3)] == \
+            ["hi", "low1", "low2"]
+
+    def test_idle_tenant_earns_no_credit(self):
+        """A tenant idle while another was served resumes at the
+        CURRENT virtual time — it cannot monopolize the queue to
+        'catch up' on service it never requested."""
+        q = TenantQueue(1000, parse_tenant_config(
+            "a:weight=1;b:weight=1"))
+        for i in range(50):
+            q.put(_req(f"a{i}", tenant="a"))
+        for _ in range(40):            # a gets served alone
+            q.get(timeout=0)
+        for i in range(50):            # b arrives late
+            q.put(_req(f"b{i}", tenant="b"))
+        pops = [q.get(timeout=0).tenant for _ in range(10)]
+        # equal weights -> roughly alternating, NOT 10x b
+        assert 3 <= pops.count("b") <= 7, pops
+
+    def test_rate_limit_429_with_retry_after(self):
+        q = TenantQueue(100, TenancyConfig(tenants={
+            "x": TenantConfig(name="x", rate=10.0, burst=2.0)}))
+        q.put(_req(tenant="x"))
+        q.put(_req(tenant="x"))
+        with pytest.raises(RateLimitedError) as e:
+            q.put(_req(tenant="x"))
+        assert 0.0 < e.value.retry_after_s <= 0.2
+        assert e.value.tenant == "x"
+        # other tenants are untouched
+        q.put(_req(tenant="y"))
+        snap = q.tenant_snapshot()
+        assert snap["x"]["counters"]["rejected_rate"] == 1
+        assert snap["x"]["shed"] == 1
+        assert snap["y"]["counters"]["admitted"] == 1
+
+    def test_queued_quota_429_but_global_full_503(self):
+        q = TenantQueue(3, TenancyConfig(tenants={
+            "x": TenantConfig(name="x", max_queued=2)}))
+        q.put(_req(tenant="x"))
+        q.put(_req(tenant="x"))
+        with pytest.raises(RateLimitedError):
+            q.put(_req(tenant="x"))     # x over ITS quota: 429
+        q.put(_req(tenant="y"))         # queue now globally full
+        with pytest.raises(QueueFullError):
+            q.put(_req(tenant="y"))     # genuine exhaustion: 503
+        snap = q.tenant_snapshot()
+        assert snap["x"]["counters"]["rejected_quota"] == 1
+        assert snap["y"]["counters"]["rejected_503"] == 1
+
+    def test_inflight_quota_releases_on_done(self):
+        q = TenantQueue(100, TenancyConfig(tenants={
+            "x": TenantConfig(name="x", max_inflight=2)}))
+        r1, r2 = _req("a", tenant="x"), _req("b", tenant="x")
+        q.put(r1)
+        q.put(r2)
+        assert q.get(timeout=0) is r1
+        assert q.get(timeout=0) is r2
+        # queue empty but both still unresolved -> quota holds
+        with pytest.raises(RateLimitedError):
+            q.put(_req("c", tenant="x"))
+        q.note_done(r1, "ok", 0.01)
+        q.put(_req("c", tenant="x"))    # slot freed
+        # double resolution counts once
+        q.note_done(r1, "ok")
+        snap = q.tenant_snapshot()
+        assert snap["x"]["inflight"] == 2
+
+    def test_quota_rechecked_after_blocking_wait(self):
+        """N blocked put(block=True) waiters must not overshoot the
+        tenant quota by N-1 once global capacity frees: the quota is
+        re-checked after any wait."""
+        q = TenantQueue(2, TenancyConfig(tenants={
+            "x": TenantConfig(name="x", max_inflight=2)}))
+        r1 = _req("x1", tenant="x")
+        q.put(r1)                       # x inflight 1
+        q.put(_req("y1", tenant="y"))   # global queue now full
+        results = []
+
+        def blocked_put(name):
+            try:
+                q.put(_req(name, tenant="x"), block=True)
+                results.append("admitted")
+            except RateLimitedError:
+                results.append("429")
+
+        threads = [threading.Thread(target=blocked_put,
+                                    args=(f"x{i}",))
+                   for i in (2, 3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)                 # both parked on capacity
+        q.get(timeout=0)                # free both global slots;
+        q.get(timeout=0)                # x inflight STAYS 1
+        for t in threads:
+            t.join(timeout=5)
+        # inflight quota 2, one in flight: exactly ONE waiter fits
+        assert sorted(results) == ["429", "admitted"], results
+
+    def test_tenant_cardinality_bounded(self):
+        tc = TenancyConfig(max_tenants=4)
+        q = TenantQueue(1000, tc)
+        for i in range(20):
+            q.put(_req(f"r{i}", tenant=f"minted-{i}"))
+        depths = q.tenant_depths()
+        assert len(depths) <= 5     # 4 + the anonymous fold target
+        assert depths[tc.anonymous]["queue_depth"] > 0
+
+
+# ---------------------------------------------------------------
+# scheduler integration: fairness, accounting, drain, no-dump
+# ---------------------------------------------------------------
+
+def _instant(req):
+    return AnalyzedWork(finish=lambda f, d: req.name)
+
+
+class TestSchedulerTenancy:
+    def test_service_share_under_load(self):
+        """(b) observed service share converges to configured
+        weights: two tenants keep a backlog in front of a 1-worker
+        scheduler; tenant 'big' (weight 3) must finish ~3x as many
+        requests as 'small' in any early window."""
+        done = []
+
+        def analyze(req):
+            time.sleep(0.003)
+            return AnalyzedWork(finish=lambda f, d: req.name)
+
+        cfg = SchedConfig(
+            workers=1, flush_timeout_s=0.001, max_batch_items=1,
+            max_queue=400,
+            tenancy=parse_tenant_config("big:weight=3;small:weight=1"))
+        sched = ScanScheduler(config=cfg)
+        try:
+            reqs = []
+            for i in range(60):
+                for t in ("big", "small"):
+                    r = ScanRequest(f"{t}{i}", analyze, tenant=t,
+                                    on_done=lambda rq: done.append(
+                                        rq.tenant))
+                    reqs.append(sched.submit(r, block=True))
+            for r in reqs:
+                r.result(timeout=60)
+            window = done[:40]
+            big = window.count("big") / len(window)
+            assert 0.55 <= big <= 0.95, \
+                f"big's early share {big} not ~0.75: {window}"
+        finally:
+            sched.close()
+
+    def test_race_books_balance_per_tenant(self, make_faults):
+        """(a) K tenants submit concurrently against quotas, rate
+        limits, deadlines, and injected device failures: every
+        request ends in exactly one of ok/degraded/429/503/408 and
+        the global AND per-tenant books balance."""
+        inj = make_faults("device_fail_rate=0.3,seed=11")
+        tenancy = TenancyConfig(tenants={
+            "flooder": TenantConfig(name="flooder", rate=50.0,
+                                    burst=5.0, max_queued=4)})
+        sched = ScanScheduler(config=SchedConfig(
+            max_queue=8, workers=2, flush_timeout_s=0.005,
+            tenancy=tenancy))
+        sched.fault_injector = inj
+        n = 48
+        outcomes: dict = {}
+
+        def one(i):
+            tenant = ("flooder", "t1", "t2", "t3")[i % 4]
+
+            def analyze(req):
+                time.sleep(0.002)
+                return AnalyzedWork(finish=lambda f, d: f"r{i}")
+            try:
+                req = sched.submit(ScanRequest(
+                    f"r{i}", analyze, tenant=tenant,
+                    deadline_s=0.05 if i % 7 == 0 else 10.0))
+            except RateLimitedError:
+                outcomes[i] = "429"
+                return
+            except QueueFullError:
+                outcomes[i] = "503"
+                return
+            try:
+                req.result(timeout=30)
+            except DeadlineExceeded:
+                outcomes[i] = "408"
+                return
+            except Exception as e:      # noqa: BLE001
+                outcomes[i] = f"error:{type(e).__name__}"
+                return
+            outcomes[i] = "degraded" if req.faults else "ok"
+
+        try:
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+            assert len(outcomes) == n
+            assert set(outcomes.values()) <= \
+                {"ok", "degraded", "429", "503", "408"}, outcomes
+            c = sched.metrics.snapshot()["counters"]
+            resolved = (c["completed"] + c["failed"] +
+                        c["timed_out"] + c["cancelled"])
+            assert c["submitted"] == resolved
+            assert c["rate_limited"] == \
+                sum(1 for v in outcomes.values() if v == "429")
+            # per-tenant books: admitted == sum of outcomes
+            for name, snap in \
+                    sched.queue.tenant_snapshot().items():
+                b = snap["counters"]
+                assert b["admitted"] == (
+                    b["ok"] + b["degraded"] + b["failed"] +
+                    b["timed_out"] + b["cancelled"]), (name, b)
+            # only the flooder was 429d
+            snap = sched.queue.tenant_snapshot()
+            for name in ("t1", "t2", "t3"):
+                assert snap[name]["shed"] == 0, snap[name]
+        finally:
+            sched.close()
+
+    def test_drain_completes_with_tenant_queues_populated(self):
+        """(c) graceful drain finishes every admitted request when
+        multiple per-tenant sub-queues hold work."""
+        gate = threading.Event()
+
+        def analyze(req):
+            gate.wait(5)
+            return AnalyzedWork(finish=lambda f, d: req.name)
+
+        sched = ScanScheduler(config=SchedConfig(
+            workers=2, flush_timeout_s=0.005,
+            tenancy=parse_tenant_config("a:weight=2;b:weight=1")))
+        reqs = [sched.submit(ScanRequest(
+            f"{t}{i}", analyze, tenant=t))
+            for i in range(3) for t in ("a", "b", "c")]
+        done = {}
+
+        def drainer():
+            done["drained"] = sched.drain(timeout_s=10)
+
+        t = threading.Thread(target=drainer)
+        t.start()
+        time.sleep(0.05)
+        with pytest.raises(SchedulerClosed):
+            sched.submit(ScanRequest("late", analyze, tenant="a"))
+        gate.set()
+        t.join(timeout=15)
+        assert done.get("drained") is True
+        for r in reqs:
+            assert r.result(timeout=5) == r.name
+
+    def test_blocking_fleet_survives_rate_limit(self, tmp_path):
+        """A closed-loop fleet scan (block=True submits) against a
+        rate-limited tenant WAITS out the bucket instead of dying:
+        per-slot isolation means a 429 must never kill the fleet."""
+        from test_sched import make_fleet, make_store
+        from trivy_tpu.runtime import BatchScanRunner
+        paths = make_fleet(tmp_path, 4, shared_secret=False)
+        runner = BatchScanRunner(
+            store=make_store(), backend="cpu",
+            sched=SchedConfig(
+                workers=2, flush_timeout_s=0.01,
+                tenancy=parse_tenant_config(
+                    "default:rate=20,burst=1")))
+        try:
+            results = runner.scan_paths(paths)
+        finally:
+            runner.close()
+        assert len(results) == 4
+        assert not any(r.error for r in results)
+
+    def test_429_storm_never_dumps_traces(self, tmp_path):
+        """PR 4's no-dump rule extends to the 429 path: a tenant
+        flood's rejections end status=rejected and must never write
+        flight-recorder dumps — a flood is not a disk-write storm."""
+        from trivy_tpu.obs.trace import Tracer
+        tracer = Tracer()
+        tracer.recorder.dump_dir = str(tmp_path / "dumps")
+        tenancy = TenancyConfig(tenants={
+            "flood": TenantConfig(name="flood", rate=1.0,
+                                  burst=1.0, max_queued=1)})
+        sched = ScanScheduler(
+            config=SchedConfig(workers=1, tenancy=tenancy),
+            tracer=tracer)
+        try:
+            ok = sched.submit(ScanRequest("first", _instant,
+                                          tenant="flood"))
+            rejected = 0
+            for i in range(32):
+                try:
+                    sched.submit(ScanRequest(f"f{i}", _instant,
+                                             tenant="flood"))
+                except RateLimitedError:
+                    rejected += 1
+            assert rejected > 0
+            ok.result(timeout=10)
+        finally:
+            sched.close()
+        assert tracer.recorder.dumps == 0
+        assert not (tmp_path / "dumps").exists()
+        assert sched.metrics.snapshot()["counters"][
+            "rate_limited"] == rejected
+
+
+# ---------------------------------------------------------------
+# RPC surface: 429 + Retry-After end-to-end, client honor,
+# per-tenant idempotency
+# ---------------------------------------------------------------
+
+class TestRpcTenancy:
+    def _server(self, tenancy=None, sched_kw=None):
+        from trivy_tpu.db import AdvisoryStore
+        from trivy_tpu.rpc.server import ScanServer, serve
+        store = AdvisoryStore()
+        store.put_advisory("alpine 3.9", "pkg0", "CVE-2020-1000",
+                           {"FixedVersion": "2.0.0-r0"})
+        store.put_vulnerability("CVE-2020-1000",
+                                {"Severity": "HIGH"})
+        cfg = SchedConfig(flush_timeout_s=0.02, workers=2,
+                          tenancy=tenancy, **(sched_kw or {}))
+        srv = ScanServer(store=store, sched=cfg)
+        httpd, _ = serve(port=0, server=srv)
+        return srv, httpd, \
+            f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def test_flooding_tenant_gets_429_with_retry_after(self):
+        import urllib.error
+        import urllib.request
+        from trivy_tpu.rpc.server import SCANNER_PREFIX
+        tenancy = TenancyConfig(tenants={
+            "flood": TenantConfig(name="flood", rate=1.0,
+                                  burst=1.0)})
+        srv, httpd, url = self._server(tenancy=tenancy)
+        try:
+            def post(tenant):
+                body = json.dumps({
+                    "target": "t", "artifact_id": "a",
+                    "blob_ids": ["missing"],
+                    "options": {"backend": "cpu"}}).encode()
+                req = urllib.request.Request(
+                    url + SCANNER_PREFIX + "Scan", data=body,
+                    method="POST",
+                    headers={"Content-Type": "application/json",
+                             "Trivy-Tenant": tenant})
+                return urllib.request.urlopen(req, timeout=10)
+
+            post("flood").read()         # burst token spent
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post("flood")
+            assert e.value.code == 429
+            retry_after = e.value.headers.get("Retry-After")
+            assert retry_after and float(retry_after) > 0
+            body = json.loads(e.value.read())
+            assert body["code"] == "rate_limited"
+            assert body["retry_after_s"] > 0
+            # a compliant tenant sails through
+            post("calm").read()
+            m = srv.metrics()
+            assert m["tenants"]["flood"]["shed"] == 1
+            assert m["tenants"]["calm"]["shed"] == 0
+        finally:
+            srv.close()
+            httpd.shutdown()
+
+    def test_client_honors_retry_after_and_counts(self):
+        """The Scan retry loop sleeps the server's Retry-After on
+        429 (not the raw exponential) and surfaces the retry in
+        ``counters['rate_limited']`` — mirroring what
+        artifact/registry.py does as a registry client."""
+        import http.server
+        import threading as _t
+        from trivy_tpu.rpc.client import _Client
+
+        hits = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                hits.append(time.monotonic())
+                self.rfile.read(int(
+                    self.headers.get("Content-Length") or 0))
+                if len(hits) == 1:
+                    body = b'{"code": "rate_limited"}'
+                    self.send_response(429)
+                    self.send_header("Retry-After", "0.15")
+                else:
+                    body = b'{"ok": true}'
+                    self.send_response(200)
+                self.send_header("Content-Length",
+                                 str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), H)
+        _t.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            c = _Client(
+                f"http://127.0.0.1:{httpd.server_address[1]}",
+                max_retries=3, backoff_base_s=10.0)
+            out = c.call("/x", {})
+            assert out == {"ok": True}
+            assert len(hits) == 2
+            # slept the server's 0.15s hint, NOT the 10s base
+            assert 0.12 <= hits[1] - hits[0] < 2.0
+            assert c.counters["rate_limited"] == 1
+            assert c.counters["retries"] == 1
+        finally:
+            httpd.shutdown()
+
+    def test_client_retry_capped_at_deadline(self):
+        """With a deadline smaller than the server's Retry-After,
+        the retry loop gives up instead of sleeping past the point
+        where the answer could matter."""
+        import http.server
+        import threading as _t
+        from trivy_tpu.rpc.client import RPCError, _Client
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(
+                    self.headers.get("Content-Length") or 0))
+                body = b'{"code": "rate_limited"}'
+                self.send_response(429)
+                self.send_header("Retry-After", "30")
+                self.send_header("Content-Length",
+                                 str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), H)
+        _t.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            c = _Client(
+                f"http://127.0.0.1:{httpd.server_address[1]}",
+                max_retries=5)
+            t0 = time.monotonic()
+            with pytest.raises(RPCError) as e:
+                c.call("/x", {}, deadline_s=0.2)
+            assert e.value.code == 429
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            httpd.shutdown()
+
+    def test_idempotency_is_per_tenant(self):
+        from trivy_tpu.rpc.server import _IdempotencyCache
+        cache = _IdempotencyCache()
+        fresh_a, entry_a = cache.claim("key1", "alice")
+        assert fresh_a
+        entry_a.resolve(result={"who": "alice"})
+        # same key, OTHER tenant: a fresh claim, never alice's result
+        fresh_b, entry_b = cache.claim("key1", "bob")
+        assert fresh_b
+        # alice replays her own
+        fresh_a2, entry_a2 = cache.claim("key1", "alice")
+        assert not fresh_a2
+        assert entry_a2.outcome(timeout=1) == {"who": "alice"}
+
+    def test_idempotency_per_tenant_entry_cap(self):
+        from trivy_tpu.rpc.server import _IdempotencyCache
+        cache = _IdempotencyCache(per_tenant_cap=2)
+        for i in range(4):
+            cache.claim(f"k{i}", "flood")[1].resolve(result=i)
+        keep, _ = cache.claim("stable", "calm")
+        assert keep
+        # the flooder evicted ITS OWN oldest entries...
+        fresh, _ = cache.claim("k0", "flood")
+        assert fresh                        # k0 was evicted
+        # ...and calm's window is untouched
+        fresh, entry = cache.claim("stable", "calm")
+        assert not fresh
+        s = cache.stats()
+        assert s["evictions"] >= 2 and s["tenants"] == 2
+
+
+# ---------------------------------------------------------------
+# metrics surface (also part of pytest -m obs)
+# ---------------------------------------------------------------
+
+@pytest.mark.obs
+class TestTenantMetricsSurface:
+    def test_json_and_prometheus_expose_per_tenant_series(self):
+        from trivy_tpu.rpc.server import ScanServer
+        tenancy = TenancyConfig(tenants={
+            "flood": TenantConfig(name="flood", rate=1.0,
+                                  burst=1.0, max_queued=1)})
+        srv = ScanServer(sched=SchedConfig(
+            workers=1, flush_timeout_s=0.005, tenancy=tenancy))
+        sched = srv.scheduler
+        try:
+            done = sched.submit(ScanRequest("ok", _instant,
+                                            tenant="calm"))
+            done.result(timeout=10)
+            with pytest.raises(RateLimitedError):
+                for i in range(8):
+                    sched.submit(ScanRequest(f"f{i}", _instant,
+                                             tenant="flood"))
+            m = srv.metrics()
+            assert "flood" in m["tenants"]
+            calm = m["tenants"]["calm"]
+            assert calm["counters"]["admitted"] >= 1
+            assert calm["counters"]["ok"] >= 1
+            assert "queue_depth" in calm and "inflight" in calm
+            assert calm["latency"]["count"] >= 1
+            assert m["tenants"]["flood"]["shed"] >= 1
+            text = srv.metrics_text()
+            assert 'trivy_tpu_tenant_events_total{tenant="calm"' \
+                in text
+            assert ',event="admitted"}' in text
+            assert 'trivy_tpu_tenant_shed_total{tenant="flood"}' \
+                in text
+            assert 'trivy_tpu_tenant_queue_depth{' in text
+            assert 'trivy_tpu_tenant_request_seconds_bucket{' \
+                'tenant="calm"' in text
+            assert 'trivy_tpu_tenant_request_seconds_count{' \
+                'tenant="calm"} 1' in text
+        finally:
+            srv.close()
+
+    def test_sched_counters_include_rate_limited(self):
+        sched = ScanScheduler(config=SchedConfig())
+        try:
+            counters = sched.metrics.snapshot()["counters"]
+            assert counters["rate_limited"] == 0
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------
+# faults spec: the tenant-flood scenario is declarative
+# ---------------------------------------------------------------
+
+class TestTenantFloodSpec:
+    def test_scenario_parses(self):
+        from trivy_tpu.faults import parse_fault_spec
+        spec = parse_fault_spec("tenant-flood")
+        assert spec.wants_tenant_flood()
+        assert spec.flood_tenant == "flooder"
+        assert spec.flood_rate > 0 and spec.flood_n > 0
+
+    def test_overrides(self):
+        from trivy_tpu.faults import parse_fault_spec
+        spec = parse_fault_spec(
+            "tenant-flood:flood_tenant=evil,flood_rate=99.5,"
+            "flood_n=7")
+        assert (spec.flood_tenant, spec.flood_rate, spec.flood_n) \
+            == ("evil", 99.5, 7)
+
+    def test_healthy_spec_wants_no_flood(self):
+        from trivy_tpu.faults import parse_fault_spec
+        assert not parse_fault_spec("").wants_tenant_flood()

@@ -26,7 +26,6 @@ import hashlib
 import http.client
 import json
 import os
-import random
 import shutil
 import ssl
 import tempfile
@@ -150,23 +149,24 @@ class DistributionClient:
             raise RegistryError(f"registry unreachable: {e!r}")
 
     def _backoff(self, attempt: int, hdrs: Optional[dict]) -> None:
-        delay = None
+        from ..utils.backoff import (full_jitter_delay,
+                                     parse_retry_after)
         retry_after = ""
         for k, v in (hdrs or {}).items():
             if k.lower() == "retry-after":
                 retry_after = v
                 break
-        if retry_after:
-            try:
-                delay = min(float(retry_after), self.backoff_max_s)
-            except ValueError:
-                pass                # HTTP-date form: fall through
-        if delay is None:
-            # full jitter on an exponential base — a retrying fleet
-            # must not re-synchronize onto the throttled registry
-            delay = min(self.backoff_max_s,
-                        self.backoff_s * (2 ** attempt))
-            delay *= random.random()
+        # the registry's Retry-After is honored (clamped to this
+        # client's own ceiling); otherwise full jitter — a retrying
+        # fleet must not re-synchronize onto the throttled registry.
+        # One shared policy implementation (utils/backoff.py) for
+        # this client and rpc/client.py
+        hint = parse_retry_after(retry_after)
+        if hint is not None:
+            delay = min(hint, self.backoff_max_s)
+        else:
+            delay = full_jitter_delay(attempt, self.backoff_s,
+                                      self.backoff_max_s)
         time.sleep(delay)
 
     def _open(self, url: str, headers: dict) -> tuple:

@@ -148,6 +148,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="admission queue bound (backpressure)")
         sp.add_argument("--sched-workers", type=int, default=4,
                         help="host worker pool size")
+        sp.add_argument("--tenant-config", default="",
+                        help="multi-tenant QoS table "
+                        "(docs/serving.md): a JSON file path or an "
+                        "inline spec like "
+                        "'alice:weight=4,rate=100;default:rate=50' "
+                        "— per-tenant WFQ weights, max_queued/"
+                        "max_inflight quotas, and token-bucket "
+                        "rate/burst limits (429 + Retry-After)")
         sp.add_argument("--fault-spec", default="",
                         help="inject deterministic faults "
                         "(docs/robustness.md): a scenario name "
@@ -328,6 +336,13 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--sched-flush-ms", type=float, default=50.0)
     srv.add_argument("--sched-queue", type=int, default=256)
     srv.add_argument("--sched-workers", type=int, default=4)
+    srv.add_argument("--tenant-config", default="",
+                     help="multi-tenant QoS table (docs/serving.md "
+                     "'Multi-tenant QoS'): JSON file or inline "
+                     "'name:weight=4,rate=100;...' — tenants come "
+                     "from the Trivy-Tenant header or body field; "
+                     "over-quota tenants get 429 + Retry-After "
+                     "while compliant tenants' p99 holds")
     srv.add_argument("--sched-deadline", default="",
                      help="default per-request deadline "
                      "(Go duration, e.g. 30s; requests may "
@@ -725,7 +740,11 @@ def run_server(args) -> int:
             return 1
     sched = "off"
     if getattr(args, "sched", "on") == "on":
-        cfg = _sched_config(args)
+        try:
+            cfg = _sched_config(args)
+        except ValueError as e:
+            print(f"error: --tenant-config: {e}", file=sys.stderr)
+            return 2
         if getattr(args, "sched_deadline", ""):
             from .flag import parse_duration
             try:
@@ -1191,12 +1210,19 @@ def _reject_unwired_fault_spec(args) -> bool:
 
 
 def _sched_config(args):
-    from .sched import SchedConfig
+    from .sched import SchedConfig, parse_tenant_config
+    tenancy = None
+    if getattr(args, "tenant_config", ""):
+        # a typo'd tenant table must fail the run up front — a
+        # malformed QoS config silently granting unlimited service
+        # is exactly the overload hole tenancy exists to close
+        tenancy = parse_tenant_config(args.tenant_config)
     return SchedConfig(
         max_queue=getattr(args, "sched_queue", 256),
         workers=getattr(args, "sched_workers", 4),
         flush_timeout_s=getattr(args, "sched_flush_ms", 50.0)
-        / 1000.0)
+        / 1000.0,
+        tenancy=tenancy)
 
 
 def _run_image_batch(args, targets: list) -> int:
@@ -1240,11 +1266,16 @@ def _run_image_batch(args, targets: list) -> int:
               f"to the fleet (seed={injector.spec.seed})",
               file=sys.stderr)
     trace_out = _trace_out(args)
+    try:
+        sched_config = _sched_config(args)
+    except ValueError as e:
+        print(f"error: --tenant-config: {e}", file=sys.stderr)
+        return 2
     runner = BatchScanRunner(
         store=store, cache=cache, backend=backend,
         secret_scanner=opt.secret_scanner,
         sched=("on" if args.sched == "on" else "off"),
-        sched_config=_sched_config(args),
+        sched_config=sched_config,
         artifact_option=opt,
         fault_injector=injector)
     options = _scan_options(args)

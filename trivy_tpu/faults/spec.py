@@ -62,6 +62,16 @@ class FaultSpec:
     #    guard layer must quarantine each one per-target
     hostile: tuple = ()
 
+    # -- tenant flood (docs/serving.md "Multi-tenant QoS"): like
+    #    deadline-storm, the spec only carries the storm's shape —
+    #    the harness (bench.py adversarial-tenant arm, tests) runs
+    #    an open-loop submitter AS this tenant at this rate while
+    #    compliant tenants keep their normal traffic; the tenancy
+    #    layer must shed the flood as 429s while compliant p99 holds
+    flood_tenant: str = ""
+    flood_rate: float = 0.0   # open-loop storm arrival rate, req/s
+    flood_n: int = 0          # storm submissions (0 = harness pick)
+
     def wants_cache_faults(self) -> bool:
         return bool(self.cache_fail_ops or self.cache_fail_rate)
 
@@ -72,6 +82,9 @@ class FaultSpec:
     def wants_rpc_faults(self) -> bool:
         return bool(self.rpc_error_first or self.rpc_error_rate
                     or self.rpc_drop_first or self.rpc_drop_rate)
+
+    def wants_tenant_flood(self) -> bool:
+        return bool(self.flood_tenant and self.flood_rate > 0)
 
 
 # Named presets. ``standard-outage`` is the bench/acceptance scenario:
@@ -94,6 +107,8 @@ SCENARIOS: dict = {
                         "device_fail_batches": 1,
                         "poison": ("poison",)},
     "hostile-ingest": {"hostile": ("all",)},
+    "tenant-flood": {"flood_tenant": "flooder", "flood_rate": 400.0,
+                     "flood_n": 256},
 }
 
 _FIELDS = {f.name: f for f in fields(FaultSpec)}

@@ -86,7 +86,8 @@ def _histograms(w: _Writer, name: str, label: str, hists: dict,
 
 
 def render_prometheus(stats: dict, phase_hists=None,
-                      trace_hists=None, tracer_stats=None,
+                      trace_hists=None, tenant_hists=None,
+                      tracer_stats=None,
                       recorder_stats=None) -> str:
     """Render the ``/metrics`` snapshot dict as Prometheus text."""
     w = _Writer()
@@ -216,6 +217,40 @@ def render_prometheus(stats: dict, phase_hists=None,
                  "DFA-table dispatches served per HBM upload.",
                  secret.get("dfa_upload_amortization"))
 
+    tenants = stats.get("tenants") or {}
+    if tenants:
+        # per-tenant fairness/QoS books (docs/serving.md
+        # "Multi-tenant QoS"): the compliant-p99-holds gate and the
+        # autoscaler both read these
+        name = f"{_PREFIX}_tenant_events_total"
+        w.header(name, "counter",
+                 "Per-tenant admission outcomes (admitted, ok, "
+                 "degraded, failed, timed_out, cancelled, "
+                 "rejected_rate, rejected_quota, rejected_503).")
+        for t in sorted(tenants):
+            for k in sorted(tenants[t].get("counters") or {}):
+                w.sample(name, [("tenant", t), ("event", k)],
+                         tenants[t]["counters"][k])
+        for key, help_ in (
+                ("shed", "Load the tenant itself absorbed as "
+                 "429s (rate + quota rejections)."),):
+            full = f"{_PREFIX}_tenant_{key}_total"
+            w.header(full, "counter", help_)
+            for t in sorted(tenants):
+                w.sample(full, [("tenant", t)],
+                         tenants[t].get(key))
+        for key, help_ in (
+                ("queue_depth", "Per-tenant queued requests."),
+                ("inflight",
+                 "Per-tenant admitted-but-unresolved requests."),
+                ("weight", "Configured WFQ service share.")):
+            full = f"{_PREFIX}_tenant_{key}"
+            w.header(full, "gauge", help_)
+            for t in sorted(tenants):
+                if key in tenants[t]:
+                    w.sample(full, [("tenant", t)],
+                             tenants[t].get(key))
+
     idem = stats.get("idempotency") or {}
     if idem:
         w.scalar(f"{_PREFIX}_idempotency_entries", "gauge",
@@ -224,6 +259,10 @@ def render_prometheus(stats: dict, phase_hists=None,
         w.scalar(f"{_PREFIX}_idempotency_hits_total", "counter",
                  "Duplicate Scan RPCs served from the window.",
                  idem.get("hits"))
+        w.scalar(f"{_PREFIX}_idempotency_evictions_total",
+                 "counter",
+                 "Entries dropped by the per-tenant caps.",
+                 idem.get("evictions"))
 
     adm = stats.get("admission") or {}
     if adm:
@@ -271,5 +310,8 @@ def render_prometheus(stats: dict, phase_hists=None,
                 "device, finish, request).")
     _histograms(w, "trace_span", "span", trace_hists or {},
                 "Per-phase latency derived from trace spans.")
+    _histograms(w, "tenant_request", "tenant", tenant_hists or {},
+                "Per-tenant request latency (admission to "
+                "resolution) — the fairness/QoS signal.")
 
     return "\n".join(w.lines) + "\n"

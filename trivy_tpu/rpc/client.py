@@ -19,12 +19,21 @@ from typing import Optional
 from ..types import Result
 from ..types.convert import os_from_dict, result_from_dict
 from ..utils import get_logger
-from .server import CACHE_PREFIX, DEFAULT_TOKEN_HEADER, SCANNER_PREFIX
+from ..utils.backoff import full_jitter_delay, parse_retry_after
+from .server import (CACHE_PREFIX, DEFAULT_TOKEN_HEADER,
+                     SCANNER_PREFIX, TENANT_HEADER)
 
 log = get_logger("rpc.client")
 
 MAX_RETRIES = 10
 BACKOFF_BASE_S = 0.2
+BACKOFF_MAX_S = 5.0
+# a server-sent Retry-After is honored up to this cap (it is the
+# server's authoritative shed hint, so it is NOT clamped to the
+# jitter backoff's 5s ceiling — a 20s quota-drain hint must not
+# collapse into futile 5s retries); the request deadline still caps
+# the whole loop below
+RETRY_AFTER_CAP_S = 60.0
 
 
 class RPCError(RuntimeError):
@@ -39,50 +48,108 @@ class _Client:
                  custom_headers: Optional[dict] = None,
                  max_retries: int = MAX_RETRIES,
                  backoff_base_s: float = BACKOFF_BASE_S,
-                 timeout_s: float = 300.0):
+                 backoff_max_s: float = BACKOFF_MAX_S,
+                 timeout_s: float = 300.0,
+                 tenant: str = ""):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.token_header = token_header
         self.custom_headers = custom_headers or {}
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self.timeout_s = timeout_s
+        # tenant identity sent on every call (Trivy-Tenant header);
+        # empty = the server's shared anonymous tenant
+        self.tenant = tenant
         # trace_id of the most recent Scan call (RemoteScanner):
         # lets a CLI client surface "see /trace/<id> on the server"
         self.last_trace_id = ""
+        # retry accounting: total retry sleeps taken, and how many
+        # of them were server 429 rate-limit shed (docs/serving.md
+        # "Multi-tenant QoS") vs transient 5xx/connection failures
+        self.counters = {"retries": 0, "rate_limited": 0}
 
-    def call(self, path: str, body: dict) -> dict:
-        """POST with exponential-backoff retry on transient errors
-        only (connection refused / 5xx — retry.go retries only
-        twirp.Unavailable)."""
+    def _delay(self, attempt: int, retry_after: str = "") -> float:
+        """One retry delay: the server's ``Retry-After`` when it
+        sent one (a 429's shed hint is authoritative, capped only at
+        RETRY_AFTER_CAP_S), else full jitter on an exponential base
+        — a retrying fleet must not re-synchronize onto the
+        overloaded server (same policy as artifact/registry.py's
+        registry client; shared pieces in utils/backoff.py)."""
+        hint = parse_retry_after(retry_after)
+        if hint is not None:
+            return min(hint, RETRY_AFTER_CAP_S)
+        return full_jitter_delay(attempt, self.backoff_base_s,
+                                 self.backoff_max_s)
+
+    def call(self, path: str, body: dict,
+             deadline_s: float = 0.0) -> dict:
+        """POST with bounded retries on transient errors only:
+        connection refused, 5xx (retry.go retries only
+        twirp.Unavailable), and 429 rate-limit shed — honoring the
+        server's ``Retry-After``. ``deadline_s`` caps the whole
+        retry loop: backing off past the request's own deadline
+        would only return an answer nobody is waiting for."""
         data = json.dumps(body).encode()
         last_err = None
+        t0 = time.monotonic()
         for attempt in range(self.max_retries):
-            if attempt:
-                time.sleep(self.backoff_base_s * (2 ** (attempt - 1)))
             req = urllib.request.Request(
                 self.base_url + path, data=data, method="POST",
                 headers={"Content-Type": "application/json",
                          **self.custom_headers})
             if self.token:
                 req.add_header(self.token_header, self.token)
+            if self.tenant:
+                req.add_header(TENANT_HEADER, self.tenant)
+            retry_after = ""
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
                 detail = e.read().decode("utf-8", "replace")
-                if e.code >= 500:           # transient: retry
+                if e.code == 429:
+                    # per-tenant shed: transient by contract — the
+                    # server told us exactly how long to back off.
+                    # The JSON body's retry_after_s is preferred
+                    # (sub-second precision); the Retry-After
+                    # header (integer delta-seconds per RFC 9110)
+                    # is the fallback
+                    self.counters["rate_limited"] += 1
+                    retry_after = (e.headers.get("Retry-After")
+                                   if e.headers else "") or ""
+                    try:
+                        body_hint = json.loads(detail).get(
+                            "retry_after_s")
+                        if body_hint is not None:
+                            retry_after = str(float(body_hint))
+                    except (ValueError, AttributeError):
+                        pass
+                    last_err = RPCError(e.code, detail)
+                    log.debug("rate-limited on %s (retry-after=%s)",
+                              path, retry_after)
+                elif e.code >= 500:         # transient: retry
                     last_err = RPCError(e.code, detail)
                     log.debug("retrying %s after %d: %s",
                               path, e.code, detail)
-                    continue
-                raise RPCError(e.code, detail)
+                else:
+                    raise RPCError(e.code, detail)
             except (urllib.error.URLError, OSError,
                     ConnectionError) as e:
                 last_err = RPCError("unavailable", str(e))
                 log.debug("retrying %s after %s", path, e)
-                continue
+            if attempt + 1 >= self.max_retries:
+                break
+            delay = self._delay(attempt, retry_after)
+            if deadline_s and deadline_s > 0:
+                remaining = deadline_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break           # out of deadline: fail now
+                delay = min(delay, remaining)
+            self.counters["retries"] += 1
+            time.sleep(delay)
         raise last_err
 
 
@@ -146,7 +213,9 @@ class RemoteScanner(_Client):
         self.last_trace_id = uuid.uuid4().hex
         log.debug("scan %r trace_id=%s", target.name,
                   self.last_trace_id)
-        out = self.call(SCANNER_PREFIX + "Scan", {
+        deadline_s = float(getattr(options, "deadline_s", 0.0)
+                           or 0.0)
+        body = {
             "idempotency_key": uuid.uuid4().hex,
             "trace_id": self.last_trace_id,
             "target": target.name,
@@ -160,7 +229,16 @@ class RemoteScanner(_Client):
                     options.scan_removed_packages,
                 "backend": getattr(options, "backend", "tpu"),
             },
-        })
+        }
+        if deadline_s:
+            body["deadline_s"] = deadline_s
+        if self.tenant:
+            body["tenant"] = self.tenant
+        # the retry loop is capped at the request's own deadline —
+        # a 429's Retry-After is honored, but never past the point
+        # where the answer would arrive too late to matter
+        out = self.call(SCANNER_PREFIX + "Scan", body,
+                        deadline_s=deadline_s)
         results = [result_from_dict(r)
                    for r in out.get("results") or []]
         return results, os_from_dict(out.get("os"))

@@ -19,7 +19,7 @@ from typing import Optional
 
 from ..artifact.cache import FSCache, MemoryCache
 from ..db import AdvisoryStore, CompiledDB
-from ..sched import QueueFullError
+from ..sched import QueueFullError, RateLimitedError
 from ..db.compiled import SwappableStore
 from ..scan.local import LocalScanner, ScanTarget
 from ..types import ScanOptions
@@ -32,7 +32,25 @@ log = get_logger("rpc.server")
 SCANNER_PREFIX = "/twirp/trivy.scanner.v1.Scanner/"
 CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
 DEFAULT_TOKEN_HEADER = "Trivy-Token"
+# tenant identity rides this header (or the body's "tenant" field,
+# which wins); absent both, the scan lands on the shared anonymous
+# tenant (docs/serving.md "Multi-tenant QoS")
+TENANT_HEADER = "Trivy-Tenant"
 IDEMPOTENCY_TTL_S = 300.0
+# per-tenant idempotency-window entry cap: a flooding tenant evicts
+# its OWN oldest entries, never another tenant's dedup window
+IDEMPOTENCY_TENANT_CAP = 1024
+# bound on distinct tenants tracked by the idempotency window (LRU
+# tenant eviction) — client-minted tenant ids must not grow it
+# without bound
+IDEMPOTENCY_MAX_TENANTS = 512
+
+
+def _clean_tenant(raw) -> str:
+    """Normalize a client-supplied tenant id: printable, trimmed,
+    bounded — it becomes a metrics label and a bookkeeping key."""
+    t = "".join(c for c in str(raw or "") if c.isprintable()).strip()
+    return t[:64]
 # admission control (docs/robustness.md "Untrusted input"): requests
 # beyond these caps answer 413 BEFORE any body is read or work is
 # queued — an oversized body or a 100k-blob Scan must cost the
@@ -78,37 +96,85 @@ class _IdempotencyCache:
     """Dedup window for RPC Scan: the client's 5xx retry loop can
     resend a request whose response was lost AFTER the server
     enqueued it — without this, every lost response double-enqueues
-    the scan into the scheduler."""
+    the scan into the scheduler.
 
-    def __init__(self, ttl_s: float = IDEMPOTENCY_TTL_S):
+    The window is **per-tenant**: a key collision across tenants
+    must never replay another tenant's cached result, and each
+    tenant's entries are capped (own-oldest eviction) so one tenant
+    flooding fresh keys cannot evict others' dedup windows."""
+
+    def __init__(self, ttl_s: float = IDEMPOTENCY_TTL_S,
+                 per_tenant_cap: int = IDEMPOTENCY_TENANT_CAP,
+                 max_tenants: int = IDEMPOTENCY_MAX_TENANTS):
+        from collections import OrderedDict
         self.ttl_s = ttl_s
+        self.per_tenant_cap = max(1, per_tenant_cap)
+        self.max_tenants = max(1, max_tenants)
         self._lock = threading.Lock()
-        self._entries: dict = {}
+        # tenant (LRU) -> key (insertion order) -> _IdemEntry
+        self._tenants: "OrderedDict" = OrderedDict()
         self.hits = 0
+        self.evictions = 0
 
-    def claim(self, key: str) -> tuple:
+    def _bucket(self, tenant: str):
+        from collections import OrderedDict
+        bucket = self._tenants.get(tenant)
+        if bucket is None:
+            bucket = self._tenants[tenant] = OrderedDict()
+            while len(self._tenants) > self.max_tenants:
+                # evict the least-recently-used TENANT wholesale —
+                # isolation is preserved (buckets are never merged)
+                _, dropped = self._tenants.popitem(last=False)
+                self.evictions += len(dropped)
+        else:
+            self._tenants.move_to_end(tenant)
+        return bucket
+
+    def claim(self, key: str, tenant: str = "") -> tuple:
         """(fresh, entry): fresh means the caller runs the scan and
         resolves the entry; otherwise it waits on the entry."""
         now = time.monotonic()
         with self._lock:
-            for k in [k for k, e in self._entries.items()
-                      if e.expires <= now]:
-                del self._entries[k]
-            entry = self._entries.get(key)
+            for t in list(self._tenants):
+                bucket = self._tenants[t]
+                # entries share one TTL, so insertion order IS
+                # expiry order: pop from the front and stop at the
+                # first live entry — O(expired), not O(all), which
+                # matters because this sweep runs under the global
+                # lock on every Scan RPC
+                while bucket:
+                    k, e = next(iter(bucket.items()))
+                    if e.expires > now:
+                        break
+                    del bucket[k]
+                if not bucket:
+                    del self._tenants[t]
+            bucket = self._bucket(tenant)
+            entry = bucket.get(key)
             if entry is not None:
                 self.hits += 1
                 return False, entry
             entry = _IdemEntry(self.ttl_s)
-            self._entries[key] = entry
+            bucket[key] = entry
+            while len(bucket) > self.per_tenant_cap:
+                bucket.popitem(last=False)
+                self.evictions += 1
             return True, entry
 
-    def forget(self, key: str) -> None:
+    def forget(self, key: str, tenant: str = "") -> None:
         with self._lock:
-            self._entries.pop(key, None)
+            bucket = self._tenants.get(tenant)
+            if bucket is not None:
+                bucket.pop(key, None)
 
     def stats(self) -> dict:
         with self._lock:
-            return {"entries": len(self._entries), "hits": self.hits,
+            return {"entries": sum(len(b)
+                                   for b in self._tenants.values()),
+                    "tenants": len(self._tenants),
+                    "hits": self.hits,
+                    "evictions": self.evictions,
+                    "per_tenant_cap": self.per_tenant_cap,
                     "ttl_s": self.ttl_s}
 
 
@@ -224,10 +290,11 @@ class ScanServer:
         on (or replays) the first enqueue's outcome instead."""
         if self._draining:
             raise ServerDraining("server draining, retry elsewhere")
+        tenant = _clean_tenant(body.get("tenant"))
         key = str(body.get("idempotency_key") or "")[:128]
         if not key:
             return self._scan(body)
-        fresh, entry = self._idem.claim(key)
+        fresh, entry = self._idem.claim(key, tenant)
         if not fresh:
             return entry.outcome(timeout=self._idem.ttl_s)
         try:
@@ -240,7 +307,7 @@ class ScanServer:
             # retry loop (every retry reuses the key); forget the
             # entry so the next attempt re-runs, and resolve any
             # concurrent duplicate waiters with this outcome
-            self._idem.forget(key)
+            self._idem.forget(key, tenant)
             entry.resolve(error=e)
             raise
         entry.resolve(result=out)
@@ -311,11 +378,21 @@ class ScanServer:
             return AnalyzedWork(jobs=prepared.jobs, finish=finish,
                                 group=options.backend)
 
+        try:
+            priority = int(body.get("priority") or 0)
+        except (TypeError, ValueError):
+            priority = 0
         req = ScanRequest(
             name=target.name, analyze=analyze,
             deadline_s=float(body.get("deadline_s") or 0.0),
             group=options.backend,
             on_done=lambda _req: self.store.release(),
+            # tenant identity (body field, or the Trivy-Tenant
+            # header the handler folded in): the scheduler's WFQ
+            # orders per tenant, quotas answer 429 + Retry-After.
+            # Priority jumps the line only WITHIN the tenant.
+            tenant=_clean_tenant(body.get("tenant")),
+            priority=max(-100, min(100, priority)),
             # the client's trace_id rides the body; the scheduler's
             # tracer validates it (hex only — it becomes a dump file
             # name) and roots this request's span tree under it
@@ -365,9 +442,12 @@ class ScanServer:
         from ..obs.prom import render_prometheus
         phase = self.scheduler.metrics.hist_snapshot() \
             if self.scheduler is not None else None
+        tenant = self.scheduler.queue.book.hist_snapshot() \
+            if self.scheduler is not None else None
         return render_prometheus(
             self.metrics(), phase_hists=phase,
             trace_hists=self.tracer.phase_snapshot(),
+            tenant_hists=tenant,
             tracer_stats=self.tracer.stats(),
             recorder_stats=self.tracer.recorder.stats())
 
@@ -449,16 +529,19 @@ def _make_handler(server: ScanServer):
         def log_message(self, fmt, *args):
             log.debug("http: " + fmt, *args)
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers=None) -> None:
             self._reply_text(code, json.dumps(payload),
-                             "application/json")
+                             "application/json", headers=headers)
 
         def _reply_text(self, code: int, text: str,
-                        ctype: str) -> None:
+                        ctype: str, headers=None) -> None:
             data = text.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in headers or ():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -542,6 +625,12 @@ def _make_handler(server: ScanServer):
                 self._reply(400, {"code": "malformed",
                                   "msg": "invalid json body"})
                 return
+            # tenant identity: an explicit body field wins, else the
+            # Trivy-Tenant header, else the shared anonymous tenant
+            tenant_hdr = self.headers.get(TENANT_HEADER)
+            if tenant_hdr and isinstance(body, dict) \
+                    and not body.get("tenant"):
+                body["tenant"] = tenant_hdr
             from ..sched import DeadlineExceeded, SchedulerClosed
             try:
                 out = server.handle(self.path, body)
@@ -554,6 +643,25 @@ def _make_handler(server: ScanServer):
                 # 413 is authoritative, not retryable
                 self._reply(413, {"code": "payload_too_large",
                                   "msg": str(e)})
+                return
+            except RateLimitedError as e:
+                # per-tenant quota/rate shed: 429 + Retry-After —
+                # the offending tenant backs off (the client's
+                # retry loop honors the header); other tenants'
+                # traffic is untouched, unlike a blanket 503.
+                # The HEADER is integer delta-seconds (RFC 9110 —
+                # fractional values make standards-compliant
+                # clients ignore the hint entirely); the exact
+                # float rides the JSON body as retry_after_s
+                retry_after = max(0.001, e.retry_after_s)
+                import math
+                self._reply(429, {"code": "rate_limited",
+                                  "msg": str(e),
+                                  "retry_after_s":
+                                      round(retry_after, 3)},
+                            headers=[("Retry-After",
+                                      str(int(math.ceil(
+                                          retry_after))))])
                 return
             except QueueFullError as e:
                 # backpressure: 503 is the transient code the client

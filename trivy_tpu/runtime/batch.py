@@ -204,13 +204,28 @@ class BatchScanRunner:
         scheduler and gathers results in input order; per-request
         failures (load errors, deadline expiry) fail their own slot,
         never the fleet."""
+        import time as _time
+
+        from ..sched import RateLimitedError
+
         options = options or ScanOptions(backend=self.backend)
         sched = self.scheduler
         reqs = []
         for name, img in items:
-            reqs.append(sched.submit(
-                self._image_request(sched, name, img, options),
-                block=True))
+            req = self._image_request(sched, name, img, options)
+            while True:
+                try:
+                    reqs.append(sched.submit(req, block=True))
+                    break
+                except RateLimitedError as e:
+                    # closed-loop fleet semantics: block=True means
+                    # "wait for capacity", and a tenant rate limit
+                    # is capacity too — sleep the shed hint and
+                    # retry instead of killing the whole fleet.
+                    # Serving callers (submit_path) still surface
+                    # the 429 to the client.
+                    _time.sleep(min(max(e.retry_after_s, 0.01),
+                                    5.0))
         out = []
         for (name, _), req in zip(items, reqs):
             try:
@@ -229,18 +244,23 @@ class BatchScanRunner:
         return out
 
     def submit_path(self, path: str,
-                    options: Optional[ScanOptions] = None):
+                    options: Optional[ScanOptions] = None,
+                    tenant: str = "", priority: int = 0):
         """Serving-mode entry: enqueue ONE image scan through the
         scheduler and return its ScanRequest future (``.result()``
-        blocks; raises QueueFullError on backpressure). The batch
+        blocks; raises QueueFullError on backpressure, or
+        RateLimitedError when the ``tenant`` is over its quota or
+        rate limit — docs/serving.md "Multi-tenant QoS"). The batch
         composition is the scheduler's business — concurrent
-        submitters share device dispatches."""
+        submitters share device dispatches across tenants."""
         options = options or ScanOptions(backend=self.backend)
         sched = self.scheduler
         return sched.submit(
-            self._image_request(sched, path, None, options))
+            self._image_request(sched, path, None, options,
+                                tenant=tenant, priority=priority))
 
-    def _image_request(self, sched, name: str, image, options):
+    def _image_request(self, sched, name: str, image, options,
+                       tenant: str = "", priority: int = 0):
         from ..sched import AnalyzedWork, ScanRequest
 
         scan_secrets = "secret" in options.security_checks
@@ -320,7 +340,8 @@ class BatchScanRunner:
         return ScanRequest(name=name or getattr(image, "name", ""),
                            analyze=analyze,
                            deadline_s=getattr(options, "deadline_s",
-                                              0.0) or 0.0)
+                                              0.0) or 0.0,
+                           tenant=tenant, priority=priority)
 
     def _scan_images(self, images: list,
                      options: Optional[ScanOptions] = None) -> list:

@@ -10,14 +10,23 @@ prefetch, cache warming) hangs off this subsystem.
 
 from .coalescer import Batch, Coalescer, SchedConfig
 from .metrics import LatencyHistogram, SchedMetrics
-from .queue import (AdmissionQueue, AnalyzedWork, DeadlineExceeded,
-                    QueueFullError, RequestCancelled, ScanRequest,
-                    SchedError, SchedulerClosed)
+from .queue import (AnalyzedWork, DeadlineExceeded, QueueFullError,
+                    RequestCancelled, ScanRequest, SchedError,
+                    SchedulerClosed)
 from .scheduler import ScanScheduler
+from .tenant import (RateLimitedError, TenancyConfig, TenantConfig,
+                     TenantQueue, TokenBucket, parse_tenant_config)
+
+# compatibility alias: the bounded FIFO admission queue is the
+# tenancy-aware queue with its default (single anonymous tenant,
+# unlimited) config — exactly the old behavior
+AdmissionQueue = TenantQueue
 
 __all__ = [
     "AdmissionQueue", "AnalyzedWork", "Batch", "Coalescer",
     "DeadlineExceeded", "LatencyHistogram", "QueueFullError",
-    "RequestCancelled", "ScanRequest", "ScanScheduler",
-    "SchedConfig", "SchedError", "SchedMetrics", "SchedulerClosed",
+    "RateLimitedError", "RequestCancelled", "ScanRequest",
+    "ScanScheduler", "SchedConfig", "SchedError", "SchedMetrics",
+    "SchedulerClosed", "TenancyConfig", "TenantConfig",
+    "TenantQueue", "TokenBucket", "parse_tenant_config",
 ]

@@ -59,6 +59,10 @@ class SchedConfig:
     # batching window — at moderate arrival rates the eager flush
     # would otherwise shatter batches to single requests
     eager_idle_flush: bool = True
+    # multi-tenant QoS (sched/tenant.py): a TenancyConfig with
+    # per-tenant weights, quotas, and rate limits. None = one
+    # unlimited anonymous tenant, i.e. the old single-FIFO behavior
+    tenancy: object = None
 
 
 @dataclass

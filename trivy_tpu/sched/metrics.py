@@ -88,8 +88,8 @@ class SchedMetrics:
         self._lock = threading.Lock()
         self.counters = {
             "submitted": 0, "completed": 0, "failed": 0,
-            "rejected": 0, "timed_out": 0, "cancelled": 0,
-            "batches": 0,
+            "rejected": 0, "rate_limited": 0, "timed_out": 0,
+            "cancelled": 0, "batches": 0,
         }
         self.hist = {p: LatencyHistogram() for p in self.PHASES}
         # coalescer accounting

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -77,10 +76,16 @@ class ScanRequest:
     def __init__(self, name: str, analyze: Callable,
                  deadline_s: float = 0.0, group: str = "",
                  on_done: Optional[Callable] = None,
-                 trace_id: str = ""):
+                 trace_id: str = "", tenant: str = "",
+                 priority: int = 0):
         self.name = name
         self.analyze = analyze
         self.group = group
+        # tenancy (sched/tenant.py): who owns this request (empty =
+        # the shared anonymous tenant) and its priority class WITHIN
+        # that tenant (higher pops first; FIFO within a class)
+        self.tenant = tenant
+        self.priority = priority
         # tracing (trivy_tpu/obs): an incoming trace_id (RPC clients
         # propagate theirs) is honored by the scheduler's tracer,
         # which fills these span slots at each stage boundary
@@ -177,57 +182,9 @@ class ScanRequest:
         return self._result
 
 
-class AdmissionQueue:
-    """Bounded FIFO with typed-overflow put and blocking get."""
-
-    def __init__(self, maxsize: int = 256):
-        self.maxsize = max(1, int(maxsize))
-        self._items: deque = deque()
-        self._cv = threading.Condition()
-        self._closed = False
-
-    def put(self, req: ScanRequest, block: bool = False,
-            timeout: Optional[float] = None) -> None:
-        with self._cv:
-            if self._closed:
-                raise SchedulerClosed("scheduler is closed")
-            if not block and len(self._items) >= self.maxsize:
-                raise QueueFullError(
-                    f"scan queue full ({self.maxsize} pending)")
-            deadline = (time.monotonic() + timeout
-                        if timeout is not None else None)
-            while len(self._items) >= self.maxsize:
-                remaining = None if deadline is None else \
-                    deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    raise QueueFullError(
-                        f"scan queue full ({self.maxsize} pending)")
-                self._cv.wait(remaining)
-                if self._closed:
-                    raise SchedulerClosed("scheduler is closed")
-            self._items.append(req)
-            self._cv.notify_all()
-
-    def get(self, timeout: Optional[float] = None)\
-            -> Optional[ScanRequest]:
-        with self._cv:
-            if not self._items:
-                self._cv.wait(timeout)
-            if not self._items:
-                return None
-            req = self._items.popleft()
-            self._cv.notify_all()
-            return req
-
-    def depth(self) -> int:
-        with self._cv:
-            return len(self._items)
-
-    def close(self) -> None:
-        with self._cv:
-            self._closed = True
-            self._cv.notify_all()
-
-    @property
-    def closed(self) -> bool:
-        return self._closed
+# The bounded admission queue itself lives in sched/tenant.py:
+# ``TenantQueue`` with the default (single anonymous, unlimited
+# tenant) config IS the bounded FIFO with typed-overflow put and
+# blocking get this module used to define — one copy of the subtle
+# blocking/backpressure state machine, not two. The package exports
+# ``AdmissionQueue`` as an alias for compatibility.

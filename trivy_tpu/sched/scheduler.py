@@ -41,8 +41,9 @@ from ..obs.trace import get_tracer, trace_cause
 from ..utils import get_logger
 from .coalescer import Batch, Coalescer, SchedConfig
 from .metrics import SchedMetrics
-from .queue import (AdmissionQueue, DeadlineExceeded, QueueFullError,
+from .queue import (DeadlineExceeded, QueueFullError,
                     RequestCancelled, ScanRequest, SchedulerClosed)
+from .tenant import RateLimitedError, TenantQueue
 
 log = get_logger("sched")
 
@@ -81,7 +82,12 @@ class ScanScheduler:
         # a root span with per-stage children (docs/observability.md)
         self.tracer = tracer if tracer is not None else get_tracer()
         self.metrics = SchedMetrics()
-        self.queue = AdmissionQueue(self.config.max_queue)
+        # tenancy-aware admission (sched/tenant.py): with the default
+        # (no TenancyConfig) this is exactly the old bounded FIFO —
+        # one unlimited anonymous tenant
+        self.queue = TenantQueue(self.config.max_queue,
+                                 tenancy=getattr(self.config,
+                                                 "tenancy", None))
         self.metrics.set_depth_gauge(self.queue.depth)
         self.coalescer = Coalescer(self.config)
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -199,12 +205,15 @@ class ScanScheduler:
         request.span_queue = self.tracer.child(root, "queue_wait")
         try:
             self.queue.put(request, block=block)
-        except QueueFullError:
+        except (QueueFullError, RateLimitedError) as e:
             self.metrics.inc("rejected")
-            # "rejected", not "failed": a backpressure 503 carries no
-            # diagnostic value, and the tracer only crash-dumps
-            # degraded/failed traces — a rejection storm must never
-            # become a disk-write storm
+            if isinstance(e, RateLimitedError):
+                self.metrics.inc("rate_limited")
+            # "rejected", not "failed": a backpressure 503/429
+            # carries no diagnostic value, and the tracer only
+            # crash-dumps degraded/failed traces — a rejection storm
+            # (including a tenant flood's 429s) must never become a
+            # disk-write storm
             request.span_queue.end("error")
             root.end("rejected")
             raise
@@ -230,6 +239,10 @@ class ScanScheduler:
         }
         out["backend"] = self.backend
         out["draining"] = self._draining
+        # per-tenant fairness/QoS books (docs/serving.md
+        # "Multi-tenant QoS"): queue depth, in-flight, admission and
+        # shed counters, latency quantiles — the autoscaling signal
+        out["tenants"] = self.queue.tenant_snapshot()
         with self._lock:
             out["interval_kernel_s"] = round(self._kernel_s, 4)
         return out
@@ -290,21 +303,24 @@ class ScanScheduler:
     def _complete(self, req: ScanRequest, result) -> None:
         self._clear_blob_writes(req)
         if req.set_result(result):
+            latency = time.monotonic() - req.submitted_at
             self.metrics.inc("completed")
-            self.metrics.observe(
-                "request", time.monotonic() - req.submitted_at)
-            self._end_trace(req,
-                            "degraded" if req.faults else "ok")
+            self.metrics.observe("request", latency)
+            status = "degraded" if req.faults else "ok"
+            self.queue.note_done(req, status, latency)
+            self._end_trace(req, status)
 
     def _fail(self, req: ScanRequest, err: BaseException) -> None:
         self._clear_blob_writes(req)
         if req.set_error(err):
             if isinstance(err, DeadlineExceeded):
-                self.metrics.inc("timed_out")
+                outcome = "timed_out"
             elif isinstance(err, RequestCancelled):
-                self.metrics.inc("cancelled")
+                outcome = "cancelled"
             else:
-                self.metrics.inc("failed")
+                outcome = "failed"
+            self.metrics.inc(outcome)
+            self.queue.note_done(req, outcome)
             self._end_trace(req, "failed", err)
 
     def _sweep(self, req: ScanRequest) -> bool:
