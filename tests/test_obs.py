@@ -596,3 +596,163 @@ class TestRpcPropagation:
                                blob_ids=[]), ScanOptions())
         assert re.fullmatch(r"[0-9a-f]{32}", sent["trace_id"])
         assert client.last_trace_id == sent["trace_id"]
+
+
+# ---------------------------------------------------------------
+# OpenMetrics negotiation, exemplars, cardinality, residency
+# ---------------------------------------------------------------
+
+class TestOpenMetrics:
+    def _hists_with_exemplar(self):
+        from trivy_tpu.sched.metrics import LatencyHistogram
+        h = LatencyHistogram()
+        h.observe(0.2, exemplar="ab" * 16)
+        h.observe(0.0002)                  # bucket with no exemplar
+        return {"request": h.raw()}
+
+    def test_exemplars_only_on_openmetrics(self):
+        hists = self._hists_with_exemplar()
+        plain = render_prometheus({}, phase_hists=hists)
+        om = render_prometheus({}, phase_hists=hists,
+                               openmetrics=True)
+        assert "# {" not in plain and "# EOF" not in plain
+        assert om.rstrip().endswith("# EOF")
+        ex_lines = [ln for ln in om.splitlines() if " # {" in ln]
+        assert ex_lines, "no exemplar rendered"
+        # exemplar rides the bucket the observation landed in, with
+        # the observed value attached
+        assert any('le="0.25"' in ln and
+                   'trace_id="' + "ab" * 16 + '"' in ln and
+                   " 0.2 " in ln for ln in ex_lines), ex_lines
+        # stripping exemplar suffixes yields the plain rendering
+        # minus the EOF: the sample VALUES are identical
+        stripped = "\n".join(
+            ln.split(" # {")[0] for ln in om.splitlines()
+            if ln != "# EOF")
+        assert stripped == plain.rstrip("\n")
+
+    def test_plain_output_byte_stable_without_exemplars(self):
+        """A histogram that never saw an exemplar renders the exact
+        pre-exemplar byte stream on both content types (minus the
+        OpenMetrics EOF)."""
+        from trivy_tpu.sched.metrics import LatencyHistogram
+        h = LatencyHistogram()
+        h.observe(0.01)
+        hists = {"analyze": h.raw()}
+        plain = render_prometheus({"counters": {"completed": 1}},
+                                  phase_hists=hists)
+        om = render_prometheus({"counters": {"completed": 1}},
+                               phase_hists=hists, openmetrics=True)
+        assert "# {" not in om
+        assert om == plain.rstrip("\n") + "\n# EOF\n"
+
+    def test_server_negotiates_openmetrics(self):
+        import urllib.request
+        from trivy_tpu.rpc.server import ScanServer, serve
+        tracer = Tracer()
+        server = ScanServer(sched="on", tracer=tracer)
+        httpd, _ = serve(port=0, server=server)
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            body = {"target": "t", "artifact_id": "a",
+                    "blob_ids": [], "trace_id": "cd" * 16}
+            req = urllib.request.Request(
+                base + "/twirp/trivy.scanner.v1.Scanner/Scan",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req)
+            r = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "application/openmetrics-text; "
+                                   "version=1.0.0"})
+            resp = urllib.request.urlopen(r)
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            text = resp.read().decode()
+            assert text.rstrip().endswith("# EOF")
+            assert ' # {trace_id="' in text
+            # plain Accept still gets byte-stable 0.0.4
+            r = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "text/plain"})
+            resp = urllib.request.urlopen(r)
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            plain = resp.read().decode()
+            assert "# {" not in plain and "# EOF" not in plain
+            _check_exposition(plain)
+        finally:
+            server.close()
+            httpd.shutdown()
+
+
+class TestSpanNameCardinality:
+    def test_phase_histograms_fold_to_other(self):
+        from trivy_tpu.obs.trace import MAX_PHASE_NAMES
+        t = Tracer(recorder=FlightRecorder())
+        root = t.start_request("storm")
+        for i in range(MAX_PHASE_NAMES + 50):
+            t.child(root, f"minted-{i:04d}").end()
+        root.end()
+        snap = t.phase_snapshot()
+        assert len(snap) <= MAX_PHASE_NAMES + 1
+        assert "other" in snap
+        # every observation landed somewhere: totals balance
+        assert sum(s["count"] for s in snap.values()) == \
+            MAX_PHASE_NAMES + 50
+        assert snap["other"]["count"] == 50
+
+
+class TestResidentGauges:
+    def test_resident_bytes_on_metrics(self):
+        import numpy as np
+        from trivy_tpu.db.compiled import (ResidentTables,
+                                           resident_snapshot)
+
+        class _T(ResidentTables):
+            _TABLE = "testtab"
+
+            def __init__(self):
+                self._init_resident()
+
+            def _resident_arrays(self):
+                return (np.zeros(1024, np.int32),)
+
+        t = _T()
+        t.device_tables()
+        rows = [r for r in resident_snapshot()
+                if r["table"] == "testtab"]
+        assert rows == [{"table": "testtab",
+                         "placement": "default",
+                         "bytes": 4096,
+                         "generation": t.generation}]
+        text = render_prometheus({"resident": rows})
+        assert ('trivy_tpu_resident_bytes{table="testtab",'
+                'placement="default"} 4096') in text
+        assert ('trivy_tpu_resident_generation{table="testtab",'
+                'placement="default"}') in text
+        _check_exposition(text)
+        t.invalidate_device()
+        assert not [r for r in resident_snapshot()
+                    if r["table"] == "testtab"]
+
+    def test_duplicate_placements_aggregate(self):
+        rows = [{"table": "t", "placement": "default",
+                 "bytes": 100, "generation": 1},
+                {"table": "t", "placement": "default",
+                 "bytes": 50, "generation": 3}]
+        text = render_prometheus({"resident": rows})
+        assert ('trivy_tpu_resident_bytes{table="t",'
+                'placement="default"} 150') in text
+        assert ('trivy_tpu_resident_generation{table="t",'
+                'placement="default"} 3') in text
+
+    def test_compiled_db_reports_residency(self):
+        from trivy_tpu.db import CompiledDB
+        from trivy_tpu.db.compiled import resident_snapshot
+        cdb = CompiledDB.compile(make_store())
+        cdb.device_tables()
+        rows = [r for r in resident_snapshot()
+                if r["generation"] == cdb.generation]
+        assert rows and rows[0]["table"] == "advisory_db"
+        assert rows[0]["bytes"] > 0

@@ -1,0 +1,222 @@
+"""Sampling host profiler (trivy_tpu/obs/profiler.py): folded-stack
+capture of a recognizable busy function, the per-second window math,
+the cardinality and depth bounds, overhead accounting, the
+``GET /debug/profile`` endpoint (token-protected like /trace), and
+the --profile-out device-trace hook's host dump."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from trivy_tpu.obs.profiler import (HostProfiler, device_trace,
+                                    get_profiler)
+
+pytestmark = pytest.mark.obs
+
+
+def _recognizable_spin_loop_xyzzy(stop: threading.Event) -> None:
+    while not stop.is_set():
+        sum(i * i for i in range(300))
+
+
+def _spin_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_recognizable_spin_loop_xyzzy,
+                         args=(stop,), daemon=True)
+    t.start()
+    return stop, t
+
+
+class TestSampling:
+    def test_busy_function_appears_in_collapsed(self):
+        prof = HostProfiler(hz=200)
+        stop, t = _spin_thread()
+        try:
+            prof.start()
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                time.sleep(0.02)
+                if "_recognizable_spin_loop_xyzzy" in \
+                        prof.collapsed():
+                    break
+        finally:
+            prof.stop()
+            stop.set()
+            t.join(timeout=2)
+        text = prof.collapsed()
+        assert "_recognizable_spin_loop_xyzzy" in text
+        assert prof.samples > 0 and prof.ticks > 0
+        # every line is "folded;stack count"
+        for line in text.splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and int(count) > 0
+        # heaviest-first ordering
+        counts = [int(ln.rsplit(" ", 1)[1])
+                  for ln in text.splitlines()]
+        assert counts == sorted(counts, reverse=True)
+
+    def test_sample_once_skips_requested_thread(self):
+        prof = HostProfiler()
+        me = threading.get_ident()
+        prof.sample_once(skip_thread=me)
+        # own stack never folds in: this test function's name is
+        # absent unless another thread is running it
+        assert "test_sample_once_skips_requested_thread" \
+            not in prof.collapsed()
+
+    def test_seconds_window_selects_recent_buckets(self):
+        prof = HostProfiler()
+        old = int(time.monotonic()) - 120
+        with prof._lock:
+            prof._ring[old] = {"ancient.stack": 99}
+        prof.sample_once()
+        recent = prof.folded(seconds=30)
+        assert "ancient.stack" not in recent
+        assert "ancient.stack" in prof.folded()
+
+    def test_stack_cardinality_folds_to_overflow(self):
+        prof = HostProfiler(max_stacks=16)
+        sec = int(time.monotonic())
+        with prof._lock:
+            prof._ring[sec] = {f"s{i}": 1 for i in range(16)}
+        prof.sample_once()      # at least one live stack overflows
+        assert prof.folded().get("<overflow>", 0) >= 1
+
+    def test_ring_capacity_bounded(self):
+        prof = HostProfiler(ring_seconds=5)
+        with prof._lock:
+            for i in range(50):
+                prof._ring[i] = {"s": 1}
+        prof.sample_once()
+        assert prof.stats()["buckets"] <= 6
+
+    def test_start_stop_idempotent_and_overhead_tracked(self):
+        prof = HostProfiler(hz=100)
+        prof.start()
+        prof.start()                       # second start is a no-op
+        time.sleep(0.1)
+        prof.stop()
+        prof.stop()
+        stats = prof.stats()
+        assert not stats["running"]
+        assert stats["overhead_s"] >= 0.0
+
+    def test_missed_ticks_dropped_not_replayed(self):
+        """After a stall (GIL hold, blocking C call) the fixed-rate
+        schedule drops the missed ticks instead of firing a zero-wait
+        catch-up burst that would overweight whatever runs right
+        after the stall."""
+        period = 1.0 / 49.0
+        # on schedule: the next tick advances by exactly one period
+        assert HostProfiler._next_tick(10.0, period, 10.001) == \
+            pytest.approx(10.0 + period)
+        # 5s stall: the next tick is NOW, not 10.02 — so the wait
+        # stays >= 0 and ~245 backlogged ticks never replay
+        nxt = HostProfiler._next_tick(10.0, period, 15.0)
+        assert nxt == 15.0
+
+    def test_get_profiler_singleton_env_off(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_PROFILE", "off")
+        p = get_profiler()
+        assert p is get_profiler()
+
+    def test_dump_writes_collapsed_file(self, tmp_path):
+        prof = HostProfiler()
+        prof.sample_once()
+        path = prof.dump(str(tmp_path / "sub" / "host.folded"))
+        text = open(path, encoding="utf-8").read()
+        assert text == prof.collapsed()
+
+
+class TestDeviceTraceHook:
+    def test_device_trace_dumps_host_profile(self, tmp_path):
+        out = tmp_path / "prof"
+        with device_trace(str(out)):
+            get_profiler(start=False).sample_once()
+        assert (out / "host_profile.folded").exists()
+
+    def test_falsy_dir_is_noop(self, tmp_path):
+        with device_trace(""):
+            pass                           # no dirs created
+
+
+class TestBoundedCapture:
+    def test_max_seconds_flushes_before_exit(self, tmp_path):
+        """A bounded device trace writes its artifacts when the
+        window elapses, NOT at context exit — a long-lived server
+        under --profile-out gets a usable profile while still up and
+        stops accumulating trace events."""
+        from trivy_tpu.obs.profiler import device_trace
+
+        with device_trace(str(tmp_path), max_seconds=0.05) as ctx:
+            deadline = time.monotonic() + 2.0
+            folded = tmp_path / "host_profile.folded"
+            while not folded.exists() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert folded.exists(), \
+                "window elapsed but no artifact written"
+            assert ctx._finished
+        # exit after the timer fired stays a no-op (no double-close)
+        assert ctx._finished
+
+    def test_unbounded_keeps_old_contract(self, tmp_path):
+        from trivy_tpu.obs.profiler import device_trace
+
+        with device_trace(str(tmp_path)):
+            assert not (tmp_path / "host_profile.folded").exists()
+        assert (tmp_path / "host_profile.folded").exists()
+
+
+class TestProfileEndpoint:
+    def test_debug_profile_http(self):
+        import urllib.error
+        import urllib.request
+
+        from trivy_tpu.rpc.server import ScanServer, serve
+
+        server = ScanServer()
+        server.profiler.sample_once()
+        httpd, _ = serve(port=0, server=server)
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            resp = urllib.request.urlopen(
+                base + "/debug/profile?seconds=60")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            resp.read()                    # collapsed text (may be
+            # empty when no sample landed in the window)
+            resp = urllib.request.urlopen(base + "/debug/profile")
+            assert resp.status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    base + "/debug/profile?seconds=banana")
+            assert ei.value.code == 400
+        finally:
+            server.close()
+            httpd.shutdown()
+
+    def test_debug_profile_honors_token(self):
+        import urllib.error
+        import urllib.request
+
+        from trivy_tpu.rpc.server import ScanServer, serve
+
+        server = ScanServer(token="sekrit")
+        httpd, _ = serve(port=0, server=server)
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/debug/profile")
+            assert ei.value.code == 401
+            req = urllib.request.Request(
+                base + "/debug/profile",
+                headers={"Trivy-Token": "sekrit"})
+            assert urllib.request.urlopen(req).status == 200
+        finally:
+            server.close()
+            httpd.shutdown()

@@ -1,0 +1,293 @@
+"""Idle-attribution timeline (trivy_tpu/obs/timeline.py): the
+partition invariants on seeded random span trees, the gap-cause
+priority rules, per-batch breakdowns, clock discipline (monotonic
+stamps only — a wall-clock step mid-batch moves nothing), and the
+end-to-end reconstruction over a real fleet scan on both --sched
+modes. Plus the tree-wide lint: no ``time.time()`` arithmetic inside
+``obs/`` span/timeline math."""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+from trivy_tpu.obs.timeline import (CAUSE_SPANS, CAUSES,
+                                    DEVICE_BUSY, Timeline,
+                                    from_tracer)
+
+pytestmark = pytest.mark.obs
+
+FakeSpan = namedtuple(
+    "FakeSpan", "name start_mono end_mono attrs",
+    defaults=({},))
+
+EPS = 1e-9
+
+
+def _check_partition(tl: Timeline):
+    """The load-bearing invariants: busy+idle tile the window, the
+    attribution partitions idle exactly, nothing is negative."""
+    attr = tl.attribute()
+    assert set(attr) == set(CAUSES)
+    for cause, v in attr.items():
+        assert v >= 0.0, f"negative attribution for {cause}: {v}"
+    assert abs(tl.busy_s + tl.idle_s - tl.window_s) < 1e-6
+    assert abs(sum(attr.values()) - tl.idle_s) < 1e-6
+    # intervals well-formed: sorted, disjoint, non-negative
+    for ivs in (tl.busy_intervals(), tl.idle_intervals()):
+        for (s0, e0), (s1, e1) in zip(ivs, ivs[1:]):
+            assert e0 <= s1
+        for s, e in ivs:
+            assert e >= s
+    return attr
+
+
+class TestAttribution:
+    def test_empty(self):
+        tl = Timeline([])
+        assert tl.window_s == 0.0
+        assert tl.attribute() == {c: 0.0 for c in CAUSES}
+        assert tl.report()["coverage"] == 1.0
+
+    def test_fully_busy_no_idle(self):
+        tl = Timeline([FakeSpan("device_compute", 0.0, 10.0)])
+        attr = _check_partition(tl)
+        assert tl.busy_s == 10.0
+        assert sum(attr.values()) == 0.0
+
+    def test_gap_causes_by_priority(self):
+        # busy [0,1] and [9,10]; the gap [1,9] is covered by an
+        # upload [1,2], a pack [1,3] (overlapping the upload), a
+        # decode [3,4], a device window [1,8], and nothing at [8,9]
+        spans = [
+            FakeSpan("scan", 0.0, 10.0),
+            FakeSpan("device_compute", 0.0, 1.0),
+            FakeSpan("device_compute", 9.0, 10.0),
+            FakeSpan("h2d_upload", 1.0, 2.0),
+            FakeSpan("pack", 1.0, 3.0),
+            FakeSpan("decode", 3.0, 4.0),
+            FakeSpan("device", 1.0, 8.0),
+        ]
+        attr = _check_partition(Timeline(spans))
+        # [1,2] upload wins over pack (priority), [2,3] pack,
+        # [3,4] decode, [4,8] device window -> dispatch_gap,
+        # [8,9] open scan span but nothing tracked -> unknown
+        assert attr["upload_serialized"] == pytest.approx(1.0)
+        assert attr["host_pack_bound"] == pytest.approx(1.0)
+        assert attr["collect_bound"] == pytest.approx(1.0)
+        assert attr["dispatch_gap"] == pytest.approx(4.0)
+        assert attr["unknown"] == pytest.approx(1.0)
+        assert attr["queue_empty"] == 0.0
+
+    def test_queue_empty_vs_unknown(self):
+        # no open root over [2,3] -> queue_empty; root open over
+        # [4,5] with nothing tracked -> unknown
+        spans = [
+            FakeSpan("device_compute", 0.0, 2.0),
+            FakeSpan("device_compute", 3.0, 4.0),
+            FakeSpan("scan", 4.0, 5.0),
+        ]
+        attr = _check_partition(Timeline(spans))
+        assert attr["queue_empty"] == pytest.approx(1.0)
+        assert attr["unknown"] == pytest.approx(1.0)
+
+    def test_overlapping_busy_spans_merge(self):
+        spans = [FakeSpan("device_compute", 0.0, 5.0),
+                 FakeSpan("dfa_scan", 3.0, 7.0),
+                 FakeSpan("dfa_scan", 6.0, 6.5)]
+        tl = Timeline(spans)
+        assert tl.busy_s == pytest.approx(7.0)
+        assert tl.idle_s == 0.0
+
+    def test_explicit_window_clips(self):
+        spans = [FakeSpan("device_compute", 2.0, 4.0)]
+        tl = Timeline(spans, window=(0.0, 10.0))
+        assert tl.window_s == 10.0
+        assert tl.busy_s == pytest.approx(2.0)
+        attr = _check_partition(tl)
+        assert attr["queue_empty"] == pytest.approx(8.0)
+
+    def test_unfinished_spans_ignored(self):
+        spans = [FakeSpan("device_compute", 0.0, 1.0),
+                 FakeSpan("device_compute", 2.0, None)]
+        tl = Timeline(spans)
+        assert tl.busy_s == pytest.approx(1.0)
+
+    def test_per_batch_charges_next_dispatch(self):
+        spans = [
+            FakeSpan("scan", 0.0, 10.0),
+            FakeSpan("device", 0.0, 3.0, {"batch": 1}),
+            FakeSpan("device_compute", 1.0, 3.0),
+            FakeSpan("device", 5.0, 8.0, {"batch": 2}),
+            FakeSpan("device_compute", 6.0, 8.0),
+        ]
+        per = Timeline(spans).per_batch()
+        by_batch = {b["batch"]: b for b in per}
+        # [0,1] delayed batch 1, [3,6] delayed batch 2, [8,10] tail
+        assert by_batch[1]["wait_s"] == pytest.approx(1.0)
+        assert by_batch[2]["wait_s"] == pytest.approx(3.0)
+        assert by_batch[None]["wait_s"] == pytest.approx(2.0)
+
+
+class TestPropertyRandomTrees:
+    """Seeded random span soups: the partition invariants must hold
+    for ANY input — no overlap, no negative gap, full coverage of
+    the device wall."""
+
+    NAMES = tuple(DEVICE_BUSY) + tuple(
+        n for _, names in CAUSE_SPANS for n in names) + (
+        "scan", "bogus_phase")
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_partition_invariants(self, seed):
+        rng = np.random.default_rng(1000 + seed)
+        spans = []
+        for _ in range(int(rng.integers(1, 80))):
+            s = float(rng.uniform(0, 50))
+            d = float(rng.uniform(0, 10))
+            name = self.NAMES[int(rng.integers(0, len(self.NAMES)))]
+            attrs = {"batch": int(rng.integers(1, 5))} \
+                if name == "device" and rng.random() < 0.5 else {}
+            spans.append(FakeSpan(name, s, s + d, attrs))
+        tl = Timeline(spans)
+        attr = _check_partition(tl)
+        # per-batch totals re-partition the same idle wall
+        per = tl.per_batch()
+        assert abs(sum(b["wait_s"] for b in per) - tl.idle_s) < 1e-6
+        for b in per:
+            assert abs(sum(b["attribution"].values())
+                       - b["wait_s"]) < 1e-6
+        rep = tl.report()
+        assert 0.0 <= rep["coverage"] <= 1.0
+        assert rep["attribution"].keys() == attr.keys()
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_translation_invariance(self, seed):
+        """Shifting every monotonic stamp by a constant must not
+        change a single attributed duration — the math depends on
+        relative time only."""
+        rng = np.random.default_rng(2000 + seed)
+        spans = []
+        for _ in range(40):
+            s = float(rng.uniform(0, 30))
+            d = float(rng.uniform(0, 5))
+            name = self.NAMES[int(rng.integers(0, len(self.NAMES)))]
+            spans.append(FakeSpan(name, s, s + d))
+        shift = 12345.678
+        shifted = [FakeSpan(sp.name, sp.start_mono + shift,
+                            sp.end_mono + shift) for sp in spans]
+        a0 = Timeline(spans).attribute()
+        a1 = Timeline(shifted).attribute()
+        for c in CAUSES:
+            assert a0[c] == pytest.approx(a1[c], abs=1e-6)
+
+
+class TestClockDiscipline:
+    """Wall time is labels-only: attribution must not move when the
+    wall clock steps mid-batch."""
+
+    def test_wall_step_mid_batch_does_not_move_attribution(
+            self, monkeypatch):
+        """Real spans through a real Tracer while time.time() jumps
+        by hours between spans: the reconstruction must be identical
+        to what the monotonic stamps alone dictate."""
+        import time as _time
+
+        from trivy_tpu.obs import FlightRecorder, Tracer
+
+        walls = iter([1e9, 1e9 + 7200.0, 1e9 - 3600.0] * 50)
+        real_time = _time.time
+        monkeypatch.setattr(
+            _time, "time",
+            lambda: next(walls, None) or real_time())
+        tracer = Tracer(recorder=FlightRecorder())
+        root = tracer.start_request("clock-step")
+        dev = tracer.child(root, "device")
+        comp = tracer.child(dev, "device_compute")
+        _time.sleep(0.01)
+        comp.end()
+        pack = tracer.child(dev, "pack")
+        _time.sleep(0.01)
+        pack.end()
+        dev.end()
+        root.end()
+        tl = from_tracer(tracer)
+        attr = _check_partition(tl)
+        # busy == the device_compute wall, idle is pack + glue —
+        # nothing resembling the (hours-long) wall steps appears
+        assert tl.window_s < 5.0
+        assert attr["host_pack_bound"] == pytest.approx(
+            0.01, abs=0.05)
+        assert tl.busy_s == pytest.approx(0.01, abs=0.05)
+
+    def test_monotonic_only_lint(self):
+        """Tree-wide lint: no ``time.time()`` arithmetic anywhere in
+        obs/ — wall time may be STORED as a label but never added to
+        or subtracted from anything (a wall step would corrupt span
+        durations, timeline gaps, profiler buckets and SLO
+        windows)."""
+        obs_dir = os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "trivy_tpu", "obs")
+        # time.time() adjacent to an arithmetic operator, either side
+        bad = re.compile(
+            r"(time\.time\(\)\s*[-+*/])|([-+*/]\s*time\.time\(\))")
+        offenders = []
+        for fn in sorted(os.listdir(obs_dir)):
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(obs_dir, fn),
+                      encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if bad.search(line):
+                        offenders.append(f"{fn}:{i}: "
+                                         f"{line.strip()}")
+        assert not offenders, \
+            "wall-clock arithmetic in obs/ (monotonic only):\n" + \
+            "\n".join(offenders)
+
+
+def _fleet(tmp_path, n):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import make_fleet, make_store
+    return make_fleet(str(tmp_path), n), make_store()
+
+
+class TestEndToEnd:
+    @pytest.mark.parametrize("sched", ["on", "off"])
+    def test_fleet_reconstruction(self, tmp_path, sched):
+        from trivy_tpu.obs import FlightRecorder, Tracer
+        from trivy_tpu.runtime import BatchScanRunner
+        from trivy_tpu.sched import SchedConfig
+
+        paths, store = _fleet(tmp_path, 6)
+        tracer = Tracer(recorder=FlightRecorder(capacity=64))
+        kw = {"sched": SchedConfig(workers=2)} if sched == "on" \
+            else {}
+        runner = BatchScanRunner(store=store, backend="cpu-ref",
+                                 tracer=tracer, **kw)
+        try:
+            results = runner.scan_paths(paths)
+        finally:
+            runner.close()
+        assert all(r.error == "" for r in results)
+        tl = from_tracer(tracer)
+        attr = _check_partition(tl)
+        rep = tl.report(per_batch=True)
+        assert rep["window_s"] > 0
+        # the known causes must explain the overwhelming share of
+        # idle — this is the acceptance instrument, kept honest
+        assert rep["coverage"] >= 0.9, rep
+        # a fleet scan does real packing and collecting; those
+        # causes must actually appear
+        assert attr["host_pack_bound"] > 0
+        if sched == "on":
+            assert any(b["batch"] is not None
+                       for b in rep["per_batch"])

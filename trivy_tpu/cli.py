@@ -130,8 +130,13 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--timeout", default="5m0s",
                         help="scan timeout (e.g. 5m0s)")
         sp.add_argument("--profile-dir", default="",
+                        help="older spelling of --profile-out "
+                        "(--profile-out wins when both are set)")
+        sp.add_argument("--profile-out", default="",
                         help="write a jax.profiler device trace + "
-                        "host/device phase timings here")
+                        "the host profiler's collapsed stacks "
+                        "(host_profile.folded) for flamegraphs "
+                        "(docs/observability.md 'Host profiler')")
         sp.add_argument("--sched", default="on",
                         choices=["on", "off"],
                         help="continuous-batching scheduler for "
@@ -361,6 +366,24 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["text", "json"],
                      help="log line format; json lines carry "
                      "trace_id/request_id (docs/observability.md)")
+    srv.add_argument("--slo-config", default="",
+                     help="service-level objectives "
+                     "(docs/observability.md 'SLOs & burn rates'): "
+                     "inline 'name:kind=availability,"
+                     "objective=0.999;lat:kind=latency,"
+                     "objective=0.95,threshold_s=2.5' — burn-rate "
+                     "verdicts at GET /slo, gauges on /metrics; "
+                     "default: 99% availability + 95% under 30s")
+    srv.add_argument("--profile-out", default="",
+                     help="opt-in device trace: jax.profiler trace "
+                     "into this directory plus the host profiler's "
+                     "collapsed stacks (host_profile.folded), "
+                     "capturing the server's first "
+                     "TRIVY_TPU_PROFILE_SECONDS (default 60) so a "
+                     "long-lived server neither buffers an "
+                     "unbounded trace nor defers the artifact to "
+                     "shutdown; the always-on host profiler is "
+                     "also served at GET /debug/profile?seconds=N")
 
     plug = sub.add_parser("plugin", help="manage plugins")
     plugsub = plug.add_subparsers(dest="plugin_command")
@@ -428,9 +451,21 @@ def main(argv=None) -> int:
             return 2
     from .artifact.redis_cache import RedisError
     from .artifact.s3_cache import S3Error
+    # --profile-out supersedes --profile-dir (same jax trace, plus
+    # the host profiler's folded stacks); one wrapper, never two
+    # stacked jax.profiler.trace contexts
+    profile_dir = getattr(args, "profile_out", "") or \
+        getattr(args, "profile_dir", "")
+    # a one-shot scan traces end-to-end; the SERVER would hold the
+    # jax trace open (and buffering) for its whole lifetime and
+    # write nothing until shutdown — bound its capture window so the
+    # flag yields an artifact while the server is still up
+    profile_window = float(
+        os.environ.get("TRIVY_TPU_PROFILE_SECONDS", "60")) \
+        if args.command == "server" else 0.0
     try:
         with scan_deadline(timeout_s), \
-                _profiled(getattr(args, "profile_dir", "")):
+                _profiled(profile_dir, profile_window):
             return _dispatch(args)
     except (RedisError, S3Error, ValueError) as e:
         # cache-backend connect/IO failures and bad backend values
@@ -447,17 +482,21 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _profiled(profile_dir: str):
-    """--profile-dir: capture a jax.profiler trace of the scan (the
-    reference's pprof/trace analog; SURVEY §5 tracing row). The trace
-    opens in TensorBoard/Perfetto; phase-level host/device timings
-    live in BatchScanRunner.last_stats and the bench JSON."""
+def _profiled(profile_dir: str, max_seconds: float = 0.0):
+    """--profile-out / --profile-dir: capture a jax.profiler device
+    trace of the scan (the reference's pprof/trace analog; SURVEY §5
+    tracing row) plus the host profiler's collapsed stacks
+    (host_profile.folded). The trace opens in TensorBoard/Perfetto;
+    phase-level host/device timings live in
+    BatchScanRunner.last_stats and the bench JSON. The single
+    jax-trace wrapper lives in obs.profiler.device_trace — a box
+    with no jax profiler plugin still gets the host profile."""
     if not profile_dir:
         yield
         return
-    import jax
+    from .obs.profiler import device_trace
     try:
-        with jax.profiler.trace(profile_dir):
+        with device_trace(profile_dir, max_seconds=max_seconds):
             yield
     finally:
         # the trace flushes even when the scan errors or times out —
@@ -756,11 +795,19 @@ def run_server(args) -> int:
                 return 2
         sched = cfg
     _trace_out(args)
+    slos = None
+    if getattr(args, "slo_config", ""):
+        from .obs.slo import parse_slo_config
+        try:
+            slos = parse_slo_config(args.slo_config)
+        except ValueError as e:
+            print(f"error: --slo-config: {e}", file=sys.stderr)
+            return 2
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
                         token_header=args.token_header,
-                        sched=sched)
+                        sched=sched, slos=slos)
     server.fault_injector = _fault_injector(args)
     print(f"trivy-tpu server listening on {args.listen}")
     serve_forever(host or "127.0.0.1", int(port), server,

@@ -140,6 +140,50 @@ def _next_generation() -> int:
         return _GENERATION_SEQ[0]
 
 
+import weakref
+
+# every live ResidentTables instance, for the /metrics residency
+# gauges (trivy_tpu_resident_bytes{table,placement}) — weak refs so
+# a dropped table (hot-swap, test teardown) leaves no ghost row
+_RESIDENT_REGISTRY: "weakref.WeakSet" = weakref.WeakSet()
+_RESIDENT_REG_LOCK = threading.Lock()
+
+
+def _placement_label(key) -> str:
+    """A bounded, human-stable label for a placement key: "default",
+    "mesh", or "device" — never the repr of a device object (labels
+    are /metrics cardinality)."""
+    if key == "default":
+        return "default"
+    if hasattr(key, "devices"):
+        return "mesh"
+    return "device"
+
+
+def resident_snapshot() -> list:
+    """[{table, placement, bytes, generation}] across every live
+    resident table — what ``trivy_tpu_resident_bytes`` serves. Only
+    placements currently STAGED count; ``invalidate_device`` drops
+    the rows (the superseded HBM is freed when in-flight dispatches
+    release it)."""
+    with _RESIDENT_REG_LOCK:
+        tables = list(_RESIDENT_REGISTRY)
+    out = []
+    for t in tables:
+        with t._device_lock:
+            rows = [(key, nbytes)
+                    for key, nbytes in t._device_bytes.items()]
+            gen = t.generation
+        for key, nbytes in rows:
+            out.append({"table": t._TABLE,
+                        "placement": _placement_label(key),
+                        "bytes": int(nbytes),
+                        "generation": gen})
+    out.sort(key=lambda r: (r["table"], r["placement"],
+                            r["generation"]))
+    return out
+
+
 class ResidentTables:
     """Device-residency plumbing shared by every table that lives in
     HBM across dispatches: the compiled advisory DB below and the
@@ -158,13 +202,17 @@ class ResidentTables:
     the ``_note_*`` hooks."""
 
     _UPLOAD_SPAN = "db_upload"
+    _TABLE = "advisory_db"      # /metrics residency label
 
     def _init_resident(self) -> None:
         self.generation = _next_generation()
         self._device: dict = {}
+        self._device_bytes: dict = {}   # placement -> staged bytes
         self._device_lock = threading.Lock()
         self._device_stats = {"uploads": 0, "upload_bytes": 0,
                               "dispatches": 0, "invalidations": 0}
+        with _RESIDENT_REG_LOCK:
+            _RESIDENT_REGISTRY.add(self)
 
     # --- subclass hooks ---
 
@@ -210,6 +258,7 @@ class ResidentTables:
                             jax.device_put(a, placement)
                             for a in arrs)
                 self._device[key] = placed
+                self._device_bytes[key] = nbytes
                 self._device_stats["uploads"] += 1
                 self._device_stats["upload_bytes"] += nbytes
                 self._note_upload(nbytes)
@@ -223,6 +272,7 @@ class ResidentTables:
             if not self._device:
                 return
             self._device.clear()
+            self._device_bytes.clear()
             self._device_stats["invalidations"] += 1
         self._note_invalidation()
 

@@ -209,13 +209,24 @@ def detect_pairs(jobs: list, backend: str = "tpu",
                 s_hi[si, sj] = b[:, 1]
         import time as _time
         t0 = _time.perf_counter()
+        # device_compute brackets the kernel execution alone — it is
+        # what the idle-attribution timeline (obs/timeline.py) counts
+        # as the device being busy; the H2D upload keeps its own
+        # disjoint h2d_upload span (inside _device_hits) so upload
+        # wall attributes as upload_serialized, never as compute
         if backend == "cpu-ref":
-            hits = np.asarray(interval_hits_host(
-                pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
+            with phase_span("device_compute", kind="interval",
+                            rows=P):
+                hits = np.asarray(interval_hits_host(
+                    pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
         elif mesh is not None:
-            from ..parallel.interval_shard import sharded_interval_hits
-            hits = sharded_interval_hits(
-                mesh, pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr)
+            from ..parallel.interval_shard import \
+                sharded_interval_hits
+            with phase_span("device_compute", kind="interval",
+                            rows=P):
+                hits = sharded_interval_hits(
+                    mesh, pkg_rank, v_lo, v_hi, s_lo, s_hi,
+                    flags_arr)
         else:
             hits = np.asarray(_device_hits(
                 pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr))
@@ -242,7 +253,13 @@ def _device_hits(*arrs):
     with phase_span("h2d_upload",
                     bytes=int(sum(a.nbytes for a in arrs))):
         dev = [jax.device_put(a) for a in arrs]
-    return interval_hits(*dev)
+    with phase_span("device_compute", kind="interval",
+                    rows=int(arrs[0].shape[0])):
+        # materialize INSIDE the span: interval_hits is jitted
+        # (async dispatch), so returning the lazy array would close
+        # the span after enqueue microseconds and the timeline would
+        # misattribute the real kernel wall to dispatch_gap
+        return np.asarray(interval_hits(*dev))
 
 
 class _HostFallback(Exception):
@@ -406,24 +423,36 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
         pkg_rank[:P] = ranks
         row_idx[:P] = rows
         t0 = _time.perf_counter()
+        # device_compute = kernel execution only (obs/timeline.py
+        # busy set); table staging keeps its db_upload span
         if backend == "cpu-ref":
-            hits = interval_hits_host(
-                pkg_rank, cdb.v_lo[row_idx], cdb.v_hi[row_idx],
-                cdb.s_lo[row_idx], cdb.s_hi[row_idx],
-                cdb.flags[row_idx])
+            with phase_span("device_compute", kind="interval",
+                            rows=P):
+                hits = interval_hits_host(
+                    pkg_rank, cdb.v_lo[row_idx], cdb.v_hi[row_idx],
+                    cdb.s_lo[row_idx], cdb.s_hi[row_idx],
+                    cdb.flags[row_idx])
         elif mesh is not None:
             from ..parallel.interval_shard import \
                 sharded_interval_hits_resident
             tables = cdb.device_tables(mesh=mesh)
-            hits = sharded_interval_hits_resident(
-                mesh, pkg_rank, row_idx, tables)
+            with phase_span("device_compute", kind="interval",
+                            rows=P):
+                hits = sharded_interval_hits_resident(
+                    mesh, pkg_rank, row_idx, tables)
         else:
             import jax
             from ..ops.intervals import interval_hits_resident
             tables = cdb.device_tables()
-            hits = np.asarray(interval_hits_resident(
-                jax.device_put(pkg_rank), jax.device_put(row_idx),
-                *tables))
+            with phase_span("h2d_upload",
+                            bytes=int(pkg_rank.nbytes +
+                                      row_idx.nbytes)):
+                dr = jax.device_put(pkg_rank)
+                di = jax.device_put(row_idx)
+            with phase_span("device_compute", kind="interval",
+                            rows=P):
+                hits = np.asarray(interval_hits_resident(
+                    dr, di, *tables))
         sink["device_s"] = sink.get("device_s", 0.0) + \
             _time.perf_counter() - t0
         for i in np.nonzero(hits[:P])[0]:

@@ -1,21 +1,29 @@
 """Observability: per-request distributed tracing, the flight
-recorder, and Prometheus text exposition (docs/observability.md).
+recorder, Prometheus text exposition, the busy/idle timeline with
+typed idle attribution, the sampling host profiler, and the SLO
+burn-rate engine (docs/observability.md).
 
-Zero-dependency by design — spans, the ring, and the exposition
-renderer are stdlib-only, so the tracing layer can thread through
-the RPC client, the scheduler and the artifact seams without adding
-imports the hot path pays for.
+Zero-dependency by design — spans, the ring, the exposition
+renderer, the timeline math, the profiler and the SLO windows are
+stdlib-only, so the tracing layer can thread through the RPC
+client, the scheduler and the artifact seams without adding imports
+the hot path pays for.
 """
 
+from .profiler import HostProfiler, device_trace, get_profiler
 from .prom import render_prometheus
 from .recorder import FlightRecorder, RingLogHandler
+from .slo import SLO, SloEngine, default_slos, parse_slo_config
+from .timeline import Timeline, from_recorder, from_tracer
 from .trace import (NOOP_SPAN, Span, Tracer, add_event, current_span,
                     get_tracer, new_trace_id, phase_span, summarize,
                     to_chrome, trace_cause)
 
 __all__ = [
-    "FlightRecorder", "NOOP_SPAN", "RingLogHandler", "Span",
-    "Tracer", "add_event", "current_span", "get_tracer",
-    "new_trace_id", "phase_span", "render_prometheus", "summarize",
-    "to_chrome", "trace_cause",
+    "FlightRecorder", "HostProfiler", "NOOP_SPAN", "RingLogHandler",
+    "SLO", "SloEngine", "Span", "Timeline", "Tracer", "add_event",
+    "current_span", "default_slos", "device_trace", "from_recorder",
+    "from_tracer", "get_profiler", "get_tracer", "new_trace_id",
+    "parse_slo_config", "phase_span", "render_prometheus",
+    "summarize", "to_chrome", "trace_cause",
 ]

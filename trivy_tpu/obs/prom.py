@@ -1,16 +1,26 @@
-"""Prometheus text exposition (format version 0.0.4) for
-``GET /metrics`` (docs/observability.md).
+"""Prometheus text exposition for ``GET /metrics``
+(docs/observability.md).
 
 The JSON snapshot stays the default; a scrape that sends
-``Accept: text/plain`` gets this rendering instead. The input is the
-same nested dict ``ScanServer.metrics()`` serves as JSON — rendering
-is tolerant of missing sections (a scheduler-off server still
-exposes guard/admission/idempotency metrics).
+``Accept: text/plain`` gets the 0.0.4 text rendering instead, and
+one that negotiates ``application/openmetrics-text; version=1.0.0``
+gets the OpenMetrics variant — same sample lines, plus per-bucket
+**trace-id exemplars** on the latency histograms and the mandatory
+``# EOF`` terminator. Exemplars ride ONLY the openmetrics content
+type: the plain 0.0.4 output stays byte-stable (Prometheus < 2.26
+and every text-format consumer in the wild chokes on the ``#``
+exemplar suffix). The input is the same nested dict
+``ScanServer.metrics()`` serves as JSON — rendering is tolerant of
+missing sections (a scheduler-off server still exposes
+guard/admission/idempotency metrics).
 
-Histograms use the raw bucket counts (``SchedMetrics.hist_snapshot``
-and ``Tracer.phase_snapshot`` both emit ``{"bounds", "counts",
-"sum", "count"}``), exposed cumulatively with the mandatory
-``+Inf`` bucket, ``_sum`` and ``_count`` series.
+Histograms use the raw bucket counts (``LatencyHistogram.raw``:
+``{"bounds", "counts", "sum", "count", "exemplars"}``), exposed
+cumulatively with the mandatory ``+Inf`` bucket, ``_sum`` and
+``_count`` series; ``exemplars`` maps bucket index to the most
+recent ``(trace_id, value, unix seconds)`` observed into it, so a
+slow-bucket scrape links straight to a representative trace at
+``/trace/<id>``.
 """
 
 from __future__ import annotations
@@ -18,6 +28,8 @@ from __future__ import annotations
 _PREFIX = "trivy_tpu"
 
 _BREAKER_STATES = ("closed", "open", "half-open")
+OPENMETRICS_CTYPE = ("application/openmetrics-text; "
+                     "version=1.0.0; charset=utf-8")
 
 
 def _fmt(v) -> str:
@@ -47,14 +59,16 @@ class _Writer:
         self.lines.append(f"# HELP {name} {help_}")
         self.lines.append(f"# TYPE {name} {mtype}")
 
-    def sample(self, name: str, labels, value) -> None:
+    def sample(self, name: str, labels, value,
+               suffix: str = "") -> None:
         if value is None:
             return
         if labels:
             lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels)
-            self.lines.append(f"{name}{{{lab}}} {_fmt(value)}")
+            self.lines.append(
+                f"{name}{{{lab}}} {_fmt(value)}{suffix}")
         else:
-            self.lines.append(f"{name} {_fmt(value)}")
+            self.lines.append(f"{name} {_fmt(value)}{suffix}")
 
     def scalar(self, name: str, mtype: str, help_: str,
                value) -> None:
@@ -64,8 +78,20 @@ class _Writer:
         self.sample(name, None, value)
 
 
+def _exemplar_suffix(h: dict, idx: int) -> str:
+    """OpenMetrics exemplar for one bucket: `` # {trace_id="…"}
+    value timestamp`` — empty when the bucket never saw a traced
+    observation."""
+    ex = (h.get("exemplars") or {}).get(idx)
+    if not ex:
+        return ""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{_esc(trace_id)}"}} '
+            f"{_fmt(float(value))} {_fmt(round(float(ts), 3))}")
+
+
 def _histograms(w: _Writer, name: str, label: str, hists: dict,
-                help_: str) -> None:
+                help_: str, openmetrics: bool = False) -> None:
     if not hists:
         return
     full = f"{_PREFIX}_{name}_seconds"
@@ -74,13 +100,17 @@ def _histograms(w: _Writer, name: str, label: str, hists: dict,
         h = hists[key]
         bounds, counts = h["bounds"], h["counts"]
         cum = 0
-        for b, c in zip(bounds, counts):
+        for i, (b, c) in enumerate(zip(bounds, counts)):
             cum += c
             w.sample(full + "_bucket",
-                     [(label, key), ("le", _fmt(float(b)))], cum)
+                     [(label, key), ("le", _fmt(float(b)))], cum,
+                     suffix=_exemplar_suffix(h, i)
+                     if openmetrics else "")
         cum += counts[len(bounds)] if len(counts) > len(bounds) else 0
         w.sample(full + "_bucket", [(label, key), ("le", "+Inf")],
-                 cum)
+                 cum,
+                 suffix=_exemplar_suffix(h, len(bounds))
+                 if openmetrics else "")
         w.sample(full + "_sum", [(label, key)], float(h["sum"]))
         w.sample(full + "_count", [(label, key)], h["count"])
 
@@ -88,8 +118,13 @@ def _histograms(w: _Writer, name: str, label: str, hists: dict,
 def render_prometheus(stats: dict, phase_hists=None,
                       trace_hists=None, tenant_hists=None,
                       tracer_stats=None,
-                      recorder_stats=None) -> str:
-    """Render the ``/metrics`` snapshot dict as Prometheus text."""
+                      recorder_stats=None,
+                      openmetrics: bool = False) -> str:
+    """Render the ``/metrics`` snapshot dict as Prometheus text.
+
+    ``openmetrics=True`` adds histogram-bucket exemplars and the
+    ``# EOF`` terminator (served under the openmetrics content
+    type); False keeps the 0.0.4 output byte-stable."""
     w = _Writer()
 
     counters = stats.get("counters") or {}
@@ -251,6 +286,72 @@ def render_prometheus(stats: dict, phase_hists=None,
                     w.sample(full, [("tenant", t)],
                              tenants[t].get(key))
 
+    slo = stats.get("slo") or {}
+    if slo.get("slos"):
+        # burn-rate verdicts (docs/observability.md "SLOs & burn
+        # rates"): the alerting/autoscaling signal GET /slo serves
+        name = f"{_PREFIX}_slo_ok"
+        w.header(name, "gauge",
+                 "1 while the SLO's error budget is not burning "
+                 "past any alert window.")
+        for v in slo["slos"]:
+            w.sample(name, [("slo", v["name"])],
+                     1 if v.get("ok") else 0)
+        name = f"{_PREFIX}_slo_burn_rate"
+        w.header(name, "gauge",
+                 "Error-budget burn rate per lookback window "
+                 "(1.0 = budget consumed exactly at period end).")
+        for v in slo["slos"]:
+            for win, rate in (v.get("burn") or {}).items():
+                w.sample(name, [("slo", v["name"]),
+                                ("window", win)], rate)
+        name = f"{_PREFIX}_slo_events_total"
+        w.header(name, "counter",
+                 "SLO-classified request outcomes.")
+        for v in slo["slos"]:
+            w.sample(name, [("slo", v["name"]),
+                            ("class", "good")], v.get("good"))
+            w.sample(name, [("slo", v["name"]),
+                            ("class", "bad")], v.get("bad"))
+        name = f"{_PREFIX}_slo_trips_total"
+        w.header(name, "counter",
+                 "Burn-rate alert trips (fast or slow window).")
+        for v in slo["slos"]:
+            w.sample(name, [("slo", v["name"])], v.get("trips"))
+        w.scalar(f"{_PREFIX}_slo_dumps_total", "counter",
+                 "Flight-recorder trace dumps triggered by burn-"
+                 "rate trips.", slo.get("dumps"))
+
+    resident = stats.get("resident") or ()
+    if resident:
+        # device-residency accounting (db/compiled.ResidentTables):
+        # live HBM bytes + generation per staged table placement.
+        # Rows aggregate per (table, placement): several live
+        # instances of one table kind (tests, a swap in flight) must
+        # not emit duplicate label sets — bytes sum, generation
+        # reports the newest
+        agg: dict = {}
+        for r in resident:
+            key = (r["table"], r["placement"])
+            cur = agg.setdefault(key, [0, 0])
+            cur[0] += r["bytes"]
+            cur[1] = max(cur[1], r["generation"])
+        name = f"{_PREFIX}_resident_bytes"
+        w.header(name, "gauge",
+                 "Bytes of device-resident tables currently staged, "
+                 "per table and placement.")
+        for (table, placement), (nbytes, _) in sorted(agg.items()):
+            w.sample(name, [("table", table),
+                            ("placement", placement)], nbytes)
+        name = f"{_PREFIX}_resident_generation"
+        w.header(name, "gauge",
+                 "Newest staged generation (hot swaps bump it; a "
+                 "stale generation on one placement means a swap "
+                 "has not reached that device set).")
+        for (table, placement), (_, gen) in sorted(agg.items()):
+            w.sample(name, [("table", table),
+                            ("placement", placement)], gen)
+
     idem = stats.get("idempotency") or {}
     if idem:
         w.scalar(f"{_PREFIX}_idempotency_entries", "gauge",
@@ -307,11 +408,15 @@ def render_prometheus(stats: dict, phase_hists=None,
 
     _histograms(w, "sched_phase_latency", "phase", phase_hists or {},
                 "Scheduler per-phase latency (queue_wait, analyze, "
-                "device, finish, request).")
+                "device, finish, request).", openmetrics)
     _histograms(w, "trace_span", "span", trace_hists or {},
-                "Per-phase latency derived from trace spans.")
+                "Per-phase latency derived from trace spans.",
+                openmetrics)
     _histograms(w, "tenant_request", "tenant", tenant_hists or {},
                 "Per-tenant request latency (admission to "
-                "resolution) — the fairness/QoS signal.")
+                "resolution) — the fairness/QoS signal.",
+                openmetrics)
 
+    if openmetrics:
+        w.lines.append("# EOF")
     return "\n".join(w.lines) + "\n"

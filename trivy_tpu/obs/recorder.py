@@ -44,6 +44,11 @@ class FlightRecorder:
         self._dump_paths: collections.deque = collections.deque()
         self.evicted = 0
         self.dumps = 0
+        # the owning Tracer's monotonic epoch — dump() subtracts it
+        # so every dump in the dir shares one timebase (us since
+        # tracer start), whoever triggers the dump (a failed scan,
+        # an SLO burn-rate trip, an operator)
+        self.epoch_mono = 0.0
 
     # --- dump location ---
 
@@ -118,12 +123,16 @@ class FlightRecorder:
         os.replace(tmp, path)
 
     def dump(self, trace_id: str, spans=None,
-             epoch_mono: float = 0.0) -> str:
+             epoch_mono: float = None) -> str:
         """Write one trace (plus the recent log tail) as Perfetto-
         loadable JSON under ``dump_dir``; returns the path. The dir
         is created private (0700) and must be owned by this uid;
-        at most ``DUMP_CAP`` dump files are kept (FIFO pruning)."""
+        at most ``DUMP_CAP`` dump files are kept (FIFO pruning).
+        ``epoch_mono`` defaults to the owning tracer's epoch so
+        every dump shares one timebase."""
         from .trace import to_chrome
+        if epoch_mono is None:
+            epoch_mono = self.epoch_mono
         if spans is None:
             spans = self.get(trace_id)
         if spans is None:

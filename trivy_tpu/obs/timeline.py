@@ -1,0 +1,287 @@
+"""Per-device busy/idle timeline reconstruction with typed idle
+attribution (docs/observability.md "Idle attribution").
+
+ROADMAP item 4 (true async runtime) is gated on ``dispatch/device
+<= 1`` — but the raw phase totals (``interval_dispatch_s`` 2x
+``interval_device_s`` on the 512-image bench) say only THAT the
+device idles, not WHY. This module rebuilds the device's busy/idle
+timeline from the span trees the tracer already records and
+attributes **every** idle instant to a typed cause, so the
+async-runtime refactor lands against a measured baseline:
+
+==================  =================================================
+cause               the device was idle because ...
+==================  =================================================
+``upload_serialized``  a host→device table/segment upload ran
+                       (``h2d_upload`` / ``db_upload`` /
+                       ``dfa_upload`` spans) — uploads serialize with
+                       compute instead of double-buffering
+``host_pack_bound``    the host was producing the next batch
+                       (``pack`` / ``analyze`` / ``join`` spans)
+``collect_bound``      the host was consuming the previous batch
+                       (``decode`` / ``report`` / ``finish`` spans)
+``dispatch_gap``       work was admitted — an open dispatch window
+                       (``device`` span) or queued work
+                       (``queue_wait`` / ``coalesce``) — but no
+                       tracked host phase covers the instant: pure
+                       dispatch-path overhead (dedup, rank-space
+                       build, result fan-out, Python glue)
+``queue_empty``        no request was open at all — the scanner was
+                       genuinely idle
+``unknown``            a request was open but nothing tracked was
+                       running (the honesty bucket; the bench gates
+                       it below 5% of idle)
+==================  =================================================
+
+Causes can overlap (the host packs batch N+1 while requests queue);
+each idle instant goes to the HIGHEST-priority overlapping cause, in
+the order above — so the attribution is a partition: the per-cause
+seconds always sum to the idle wall exactly, with no overlap and no
+negative gap (property-tested in tests/test_obs_timeline.py).
+
+Device **busy** is the union of the actual kernel-execution spans
+(``device_compute``, ``dfa_scan``) — NOT the scheduler's per-request
+``device`` dispatch windows, which bracket host packing and decode
+too; those windows are what ``dispatch_gap`` is measured against.
+
+Clock discipline: every timestamp here is ``time.monotonic`` (the
+spans' ``start_mono``/``end_mono``). Wall clock is labels-only
+throughout ``obs/`` — a wall step (NTP slew, leap smear) mid-batch
+must not move a single attributed microsecond; a lint test enforces
+that no ``time.time()`` arithmetic exists in this package.
+"""
+
+from __future__ import annotations
+
+# span names that mean the device itself was executing
+DEVICE_BUSY = frozenset({"device_compute", "dfa_scan"})
+
+# cause -> the span names whose coverage attributes an idle instant
+# to it, in PRIORITY order (first match wins inside a gap)
+CAUSE_SPANS = (
+    ("upload_serialized", frozenset({"h2d_upload", "db_upload",
+                                     "dfa_upload"})),
+    ("host_pack_bound", frozenset({"pack", "analyze", "join"})),
+    ("collect_bound", frozenset({"decode", "verify", "report",
+                                 "finish"})),
+    ("dispatch_gap", frozenset({"device", "queue_wait",
+                                "coalesce"})),
+)
+
+# any open root ("scan") span means the scanner had work somewhere;
+# idle not explained above becomes unknown instead of queue_empty
+_ROOT = "scan"
+
+CAUSES = tuple(c for c, _ in CAUSE_SPANS) + ("queue_empty",
+                                             "unknown")
+
+
+def _merge(intervals: list) -> list:
+    """Sorted union of (start, end) intervals; empty/negative
+    intervals dropped."""
+    ivs = sorted((s, e) for s, e in intervals if e > s)
+    out: list = []
+    for s, e in ivs:
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def _complement(intervals: list, lo: float, hi: float) -> list:
+    """[lo, hi] minus the (merged) intervals."""
+    out = []
+    cur = lo
+    for s, e in intervals:
+        s, e = max(s, lo), min(e, hi)
+        if e <= s:
+            continue
+        if s > cur:
+            out.append((cur, s))
+        cur = max(cur, e)
+    if hi > cur:
+        out.append((cur, hi))
+    return out
+
+
+def _clip(intervals: list, lo: float, hi: float) -> list:
+    out = []
+    for s, e in intervals:
+        s, e = max(s, lo), min(e, hi)
+        if e > s:
+            out.append((s, e))
+    return out
+
+
+def _overlap_s(intervals: list, lo: float, hi: float) -> float:
+    return sum(e - s for s, e in _clip(intervals, lo, hi))
+
+
+class Timeline:
+    """One reconstruction over a list of finished spans.
+
+    ``attribute()`` returns the partitioned idle breakdown;
+    ``report()`` the JSON-able summary the bench and ``/metrics``
+    carry. The input spans only need ``name``, ``start_mono``,
+    ``end_mono`` and ``attrs`` — a real ``obs.trace.Span``, or any
+    duck-typed stand-in (the property tests use a namedtuple)."""
+
+    def __init__(self, spans: list, window=None):
+        done = [s for s in spans
+                if getattr(s, "end_mono", None) is not None
+                and not getattr(s, "noop", False)]
+        self.spans = done
+        if window is not None:
+            self.t0, self.t1 = float(window[0]), float(window[1])
+        elif done:
+            self.t0 = min(s.start_mono for s in done)
+            self.t1 = max(s.end_mono for s in done)
+        else:
+            self.t0 = self.t1 = 0.0
+        by_name: dict = {}
+        for s in done:
+            by_name.setdefault(s.name, []).append(
+                (s.start_mono, s.end_mono))
+        self._busy = _merge([iv for n in DEVICE_BUSY
+                             for iv in by_name.get(n, ())])
+        self._cause_ivs = [
+            (cause, _merge([iv for n in names
+                            for iv in by_name.get(n, ())]))
+            for cause, names in CAUSE_SPANS]
+        self._open = _merge(by_name.get(_ROOT, []))
+        # batch ids: gaps are attached to the NEXT busy interval's
+        # covering dispatch span, so "why did batch 17 start late"
+        # is answerable per batch
+        self._batch_spans = sorted(
+            ((s.start_mono, s.end_mono, s.attrs.get("batch"))
+             for s in done
+             if s.name == "device" and s.attrs.get("batch")
+             is not None),
+            key=lambda t: t[0])
+
+    # --- the partition ---
+
+    def attribute(self) -> dict:
+        """{cause: seconds} — partitions the idle wall exactly."""
+        out = {c: 0.0 for c in CAUSES}
+        for lo, hi in self.idle_intervals():
+            for cause, dur in self._attribute_gap(lo, hi):
+                out[cause] += dur
+        return out
+
+    def _attribute_gap(self, lo: float, hi: float) -> list:
+        """Partition one idle gap into (cause, seconds) pieces:
+        sweep the elementary sub-intervals between all cause
+        boundaries, assigning each to its highest-priority cover."""
+        pts = {lo, hi}
+        for _, ivs in self._cause_ivs:
+            for s, e in _clip(ivs, lo, hi):
+                pts.add(s)
+                pts.add(e)
+        for s, e in _clip(self._open, lo, hi):
+            pts.add(s)
+            pts.add(e)
+        edges = sorted(pts)
+        out = []
+        for a, b in zip(edges, edges[1:]):
+            mid = (a + b) / 2.0
+            cause = None
+            for name, ivs in self._cause_ivs:
+                if any(s <= mid < e for s, e in ivs):
+                    cause = name
+                    break
+            if cause is None:
+                cause = "unknown" if any(
+                    s <= mid < e for s, e in self._open) \
+                    else "queue_empty"
+            out.append((cause, b - a))
+        return out
+
+    # --- intervals ---
+
+    def busy_intervals(self) -> list:
+        return _clip(self._busy, self.t0, self.t1)
+
+    def idle_intervals(self) -> list:
+        return _complement(self.busy_intervals(), self.t0, self.t1)
+
+    # --- summaries ---
+
+    @property
+    def window_s(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+    @property
+    def busy_s(self) -> float:
+        return sum(e - s for s, e in self.busy_intervals())
+
+    @property
+    def idle_s(self) -> float:
+        return sum(e - s for s, e in self.idle_intervals())
+
+    def per_batch(self) -> list:
+        """[{batch, wait_s, attribution}] — each idle gap charged to
+        the batch whose dispatch window it delayed (the next busy
+        interval's covering ``device`` span). Gaps after the last
+        batch land on batch=None."""
+        busy = self.busy_intervals()
+        out: dict = {}
+        for lo, hi in self.idle_intervals():
+            nxt = next((s for s, _ in busy if s >= hi), None)
+            batch = None
+            if nxt is not None:
+                for s, e, b in self._batch_spans:
+                    if s <= nxt < e:
+                        batch = b
+                        break
+            slot = out.setdefault(batch, {
+                "batch": batch, "wait_s": 0.0,
+                "attribution": {c: 0.0 for c in CAUSES}})
+            slot["wait_s"] += hi - lo
+            for cause, dur in self._attribute_gap(lo, hi):
+                slot["attribution"][cause] += dur
+        return [out[k] for k in sorted(
+            out, key=lambda b: (b is None, b))]
+
+    def report(self, per_batch: bool = False) -> dict:
+        """The JSON-able breakdown BENCH json and ``/metrics``
+        carry. ``coverage`` is the share of idle wall attributed to
+        a KNOWN cause (1 - unknown/idle); the bench gates it at
+        >= 95% so the taxonomy cannot silently rot."""
+        attr = self.attribute()
+        idle = self.idle_s
+        out = {
+            "window_s": round(self.window_s, 6),
+            "busy_s": round(self.busy_s, 6),
+            "idle_s": round(idle, 6),
+            "busy_ratio": round(self.busy_s / self.window_s, 4)
+            if self.window_s else 0.0,
+            "attribution": {c: round(v, 6)
+                            for c, v in attr.items()},
+            "coverage": round(1.0 - attr["unknown"] / idle, 4)
+            if idle > 0 else 1.0,
+            "gaps": len(self.idle_intervals()),
+        }
+        if per_batch:
+            out["per_batch"] = [
+                {"batch": b["batch"],
+                 "wait_s": round(b["wait_s"], 6),
+                 "attribution": {c: round(v, 6)
+                                 for c, v in
+                                 b["attribution"].items() if v}}
+                for b in self.per_batch()]
+        return out
+
+
+def from_recorder(recorder, window=None) -> Timeline:
+    """Timeline over every span in the flight-recorder ring — the
+    fleet-run entry the bench uses (a fleet's traces all complete
+    into the ring; size the ring to the fleet)."""
+    spans = [s for _, trace in recorder.traces() for s in trace]
+    return Timeline(spans, window=window)
+
+
+def from_tracer(tracer, window=None) -> Timeline:
+    return from_recorder(tracer.recorder, window=window)

@@ -42,6 +42,12 @@ _ID_RE = re.compile(r"[0-9a-f]{8,64}")
 # grow the tracer without limit
 MAX_SPANS_PER_TRACE = 4096
 MAX_OPEN_TRACES = 1024
+# distinct span NAMES tracked as /metrics histograms: each name is a
+# label value on trivy_tpu_trace_span_seconds, so a hostile or buggy
+# caller minting names must fold into "other" instead of growing the
+# exposition without bound (same policy sched/tenant.py applies to
+# tenant labels)
+MAX_PHASE_NAMES = 64
 
 
 def new_trace_id() -> str:
@@ -241,6 +247,9 @@ class Tracer:
             from .recorder import FlightRecorder
             recorder = FlightRecorder()
         self.recorder = recorder
+        # dumps triggered off-tracer (SLO burn-rate trips, operator
+        # pokes) must land on the same timebase as _finish's dumps
+        recorder.epoch_mono = self.epoch_mono
         self._phase = {} if phase_metrics else None
         self.n_spans = 0
         self.n_traces = 0
@@ -289,7 +298,8 @@ class Tracer:
 
     def _finish(self, span: Span) -> None:
         if self._phase is not None and span.parent_id is not None:
-            self._observe_phase(span.name, span.duration_s)
+            self._observe_phase(span.name, span.duration_s,
+                                span.trace_id)
         with self._lock:
             self.n_spans += 1
             if span.parent_id is not None:
@@ -307,13 +317,20 @@ class Tracer:
             self.n_traces += 1
         self._complete(span, spans)
 
-    def _observe_phase(self, name: str, dur_s: float) -> None:
+    def _observe_phase(self, name: str, dur_s: float,
+                       trace_id: str = "") -> None:
         from ..sched.metrics import LatencyHistogram
         with self._lock:
             h = self._phase.get(name)
             if h is None:
-                h = self._phase[name] = LatencyHistogram()
-            h.observe(dur_s)
+                if len(self._phase) >= MAX_PHASE_NAMES:
+                    # cardinality cap: overflow names fold into one
+                    # shared histogram so /metrics stays bounded
+                    name = "other"
+                    h = self._phase.get(name)
+                if h is None:
+                    h = self._phase[name] = LatencyHistogram()
+            h.observe(dur_s, exemplar=trace_id)
 
     def _complete(self, root: Span, spans: list) -> None:
         self.recorder.add(root.trace_id, spans)
@@ -355,11 +372,10 @@ class Tracer:
         return to_chrome(spans, self.epoch_mono, self.epoch_wall)
 
     def phase_snapshot(self) -> dict:
-        """{span name: raw histogram} for Prometheus exposition."""
+        """{span name: raw histogram} for Prometheus exposition
+        (with per-bucket trace-id exemplars)."""
         with self._lock:
-            return {name: {"bounds": list(h.BOUNDS),
-                           "counts": list(h.counts),
-                           "sum": h.sum, "count": h.total}
+            return {name: h.raw()
                     for name, h in (self._phase or {}).items()}
 
     def stats(self) -> dict:

@@ -253,6 +253,7 @@ class DfaTable(ResidentTables):
     code table), END positions for chains (file-level gates)."""
 
     _UPLOAD_SPAN = "dfa_upload"
+    _TABLE = "dfa"              # /metrics residency label
 
     def __init__(self, literals: list, chains: list):
         # literals: lowercased bytes, 1..MAX_LIT_BYTES, deduped by

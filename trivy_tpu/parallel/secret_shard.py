@@ -142,12 +142,19 @@ class ShardedSieve:
         (file_codes, runs_map) merged across shard blocks —
         ``file_codes``: file index → {pattern col: [(seg offset,
         blockmask)]}; ``runs_map``: file index → {run-spec idx}."""
+        from ..obs.trace import phase_span
         from ..runtime.hostpool import map_in_pool
         from ..secret.metrics import SECRET_METRICS
         K = self.scanner.table.n_patterns
         t0 = time.perf_counter()
-        masks = np.asarray(self._out[0])[:self.n_valid, :K]
-        runs = np.asarray(self._out[1])[:self.n_valid]
+        # the async dispatch's device wall passes HERE — the
+        # np.asarray join blocks on the mesh sieve — so this is the
+        # dfa_scan busy span the idle-attribution timeline counts
+        # (mirrors the fused path's dfa_scan(fetch=True))
+        with phase_span("dfa_scan", fetch=True,
+                        segments=int(self.n_valid)):
+            masks = np.asarray(self._out[0])[:self.n_valid, :K]
+            runs = np.asarray(self._out[1])[:self.n_valid]
         self.device_s += time.perf_counter() - t0
 
         seg_file, seg_pos = self.seg_file, self.seg_pos

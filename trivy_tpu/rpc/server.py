@@ -195,7 +195,7 @@ class ScanServer:
                  sched: str = "off", sched_config=None,
                  max_body_bytes: int = MAX_BODY_BYTES,
                  max_scan_blobs: int = MAX_SCAN_BLOBS,
-                 tracer=None):
+                 tracer=None, slos=None):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -236,6 +236,34 @@ class ScanServer:
                 from ..obs.trace import get_tracer
                 tracer = get_tracer()
         self.tracer = tracer
+        # SLO burn-rate engine (docs/observability.md "SLOs & burn
+        # rates"): scheduled servers share the scheduler's engine;
+        # sched-off servers keep their own so GET /slo answers on
+        # both paths. ``slos`` is a list of obs.slo.SLO
+        # (--slo-config); None = the default pair
+        if self.scheduler is not None:
+            if slos is not None:
+                if not self._owns_scheduler:
+                    # a shared scheduler's engine holds live burn
+                    # windows, trip latches and exemplars other
+                    # request sources depend on — silently swapping
+                    # it would reset every SLO to "ok"; the caller
+                    # must configure the scheduler it owns
+                    raise ValueError(
+                        "slos= conflicts with a shared scheduler; "
+                        "configure the scheduler's own SLO engine")
+                from ..obs.slo import SloEngine
+                self.scheduler.slo = SloEngine(
+                    slos, recorder=self.tracer.recorder)
+            self.slo = self.scheduler.slo
+        else:
+            from ..obs.slo import SloEngine
+            self.slo = SloEngine(slos,
+                                 recorder=self.tracer.recorder)
+        # the always-on sampling host profiler backing
+        # GET /debug/profile (TRIVY_TPU_PROFILE=off disables)
+        from ..obs.profiler import get_profiler
+        self.profiler = get_profiler()
 
     def close(self) -> None:
         # only tear down a scheduler this server constructed — an
@@ -339,16 +367,24 @@ class ScanServer:
         root = self.tracer.start_request(
             target.name, trace_id=str(body.get("trace_id") or ""))
         db = self.store.acquire()
+        t0 = time.monotonic()
+        tenant = _clean_tenant(body.get("tenant"))
         try:
             with root.activate():
                 scanner = LocalScanner(self.cache, db)
                 results, os_found = scanner.scan(target, options)
         except BaseException:
             root.end("failed")
+            self.slo.record("failed",
+                            latency_s=time.monotonic() - t0,
+                            tenant=tenant,
+                            trace_id=root.trace_id)
             raise
         finally:
             self.store.release()
         root.end()
+        self.slo.record("ok", latency_s=time.monotonic() - t0,
+                        tenant=tenant, trace_id=root.trace_id)
         return {
             "os": os_found.to_dict() if os_found else None,
             "results": [r.to_dict() for r in results],
@@ -426,6 +462,14 @@ class ScanServer:
             # tail, DFA upload amortization)
             from ..secret.metrics import SECRET_METRICS
             out["secret"] = SECRET_METRICS.snapshot()
+        if "resident" not in out:
+            # device-residency gauges ride the scheduler snapshot
+            # when serving is on; sched-off servers report them too
+            from ..db.compiled import resident_snapshot
+            out["resident"] = resident_snapshot()
+        if "slo" not in out:
+            out["slo"] = self.slo.snapshot()
+        out["profiler"] = self.profiler.stats()
         out["admission"] = {"max_body_bytes": self.max_body_bytes,
                             "max_scan_blobs": self.max_scan_blobs}
         breaker = getattr(self.cache, "breaker_stats", None)
@@ -435,9 +479,11 @@ class ScanServer:
                             recorder=self.tracer.recorder.stats())
         return out
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition of the same snapshot — served
-        when a /metrics scrape sends ``Accept: text/plain``
+        when a /metrics scrape sends ``Accept: text/plain``, or the
+        OpenMetrics variant (exemplars + ``# EOF``) when it
+        negotiates ``application/openmetrics-text``
         (docs/observability.md has a scrape config)."""
         from ..obs.prom import render_prometheus
         phase = self.scheduler.metrics.hist_snapshot() \
@@ -449,12 +495,23 @@ class ScanServer:
             trace_hists=self.tracer.phase_snapshot(),
             tenant_hists=tenant,
             tracer_stats=self.tracer.stats(),
-            recorder_stats=self.tracer.recorder.stats())
+            recorder_stats=self.tracer.recorder.stats(),
+            openmetrics=openmetrics)
 
     def trace(self, trace_id: str):
         """Chrome trace-event JSON for ``GET /trace/<id>``, or None
         when the id is unknown (or already evicted from the ring)."""
         return self.tracer.trace(trace_id)
+
+    def slo_verdicts(self) -> dict:
+        """The ``GET /slo`` payload: per-SLO burn rates, trip state
+        and exemplar trace ids (docs/observability.md)."""
+        return self.slo.snapshot()
+
+    def profile_text(self, seconds=None) -> str:
+        """Collapsed-stack host profile over the last ``seconds``
+        (whole ring when None) for ``GET /debug/profile``."""
+        return self.profiler.collapsed(seconds)
 
     # ---- dispatch ----
 
@@ -564,16 +621,50 @@ def _make_handler(server: ScanServer):
                 # detail in /metrics honors the server token
                 if not self._authorized():
                     return
-                # content negotiation: a Prometheus scrape sends
-                # Accept: text/plain and gets the text exposition;
-                # everything else keeps the JSON snapshot
+                # content negotiation: an OpenMetrics scrape
+                # (Accept: application/openmetrics-text) gets the
+                # 1.0.0 exposition WITH exemplars; a plain
+                # Prometheus scrape (Accept: text/plain) gets the
+                # byte-stable 0.0.4 text; everything else keeps the
+                # JSON snapshot
                 accept = self.headers.get("Accept") or ""
-                if "text/plain" in accept or "openmetrics" in accept:
+                if "application/openmetrics-text" in accept:
+                    from ..obs.prom import OPENMETRICS_CTYPE
+                    self._reply_text(
+                        200, server.metrics_text(openmetrics=True),
+                        OPENMETRICS_CTYPE)
+                elif "text/plain" in accept \
+                        or "openmetrics" in accept:
                     self._reply_text(
                         200, server.metrics_text(),
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._reply(200, server.metrics())
+            elif self.path == "/slo":
+                # SLO burn-rate verdicts: operational detail, so it
+                # honors the token like /metrics and /trace
+                if not self._authorized():
+                    return
+                self._reply(200, server.slo_verdicts())
+            elif self.path.startswith("/debug/profile"):
+                # collapsed-stack host profile
+                # (docs/observability.md "Host profiler"):
+                # ?seconds=N bounds the lookback window
+                if not self._authorized():
+                    return
+                from urllib.parse import parse_qs, urlsplit
+                q = parse_qs(urlsplit(self.path).query)
+                seconds = None
+                try:
+                    if q.get("seconds"):
+                        seconds = max(1, int(q["seconds"][0]))
+                except (TypeError, ValueError):
+                    self._reply(400, {"code": "malformed",
+                                      "msg": "bad seconds= value"})
+                    return
+                self._reply_text(
+                    200, server.profile_text(seconds),
+                    "text/plain; charset=utf-8")
             elif self.path.startswith("/trace/"):
                 # per-request trace lookup (docs/observability.md):
                 # operational detail, so it honors the token too

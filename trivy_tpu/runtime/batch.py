@@ -421,14 +421,23 @@ class BatchScanRunner:
         secret_s = _time.perf_counter() - t0
 
         # ---- phase 3: squash + advisory join (host) ----
+        from ..obs.trace import phase_span
         t0 = _time.perf_counter()
         scanner = LocalScanner(self.cache, self.store)
         prepared = []
-        for a in artifacts:
-            ref = a.reference
-            prepared.append(scanner.prepare(
-                ScanTarget(name=ref.name, artifact_id=ref.id,
-                           blob_ids=ref.blob_ids), options))
+        # the join span makes this host phase visible to the idle-
+        # attribution timeline (host_pack_bound — the device waits
+        # while the host produces the interval jobs)
+        with (sp0.activate() if sp0 is not None
+              else contextlib.nullcontext()):
+            with phase_span("join", images=len(artifacts)):
+                for a in artifacts:
+                    ref = a.reference
+                    prepared.append(scanner.prepare(
+                        ScanTarget(name=ref.name,
+                                   artifact_id=ref.id,
+                                   blob_ids=ref.blob_ids),
+                        options))
         join_s = _time.perf_counter() - t0
 
         # ---- phase 4: ONE interval dispatch over all images ----
@@ -460,20 +469,28 @@ class BatchScanRunner:
         t0 = _time.perf_counter()
         if sieve_handle is not None:
             from ..applier import merge_layer_secrets
-            found = self.secret_scanner.collect(sieve_handle)
-            _patch_blobs(self.cache, artifacts, found)
-            sec_stats = dict(getattr(self.secret_scanner,
-                                     "stats", {}))
-            # re-merge EVERY artifact: a patched blob may be shared
-            # with artifacts whose own `collected` is empty (fleets
-            # share layers — the cached-layer case), and their
-            # prepare() ran before the patch landed. Nothing found →
-            # nothing patched → prepare()'s merge already stands.
-            if found:
-                for a, p in zip(artifacts, prepared):
-                    blobs = [self.cache.get_blob(b)
-                             for b in a.reference.blob_ids]
-                    p.detail.secrets = merge_layer_secrets(blobs)
+            with (sp0.activate() if sp0 is not None
+                  else contextlib.nullcontext()):
+                # collect emits its own dfa_scan(fetch)/decode/
+                # verify phase spans; the blob patch + re-merge is
+                # collect-side host work too
+                found = self.secret_scanner.collect(sieve_handle)
+                with phase_span("decode", stage="patch"):
+                    _patch_blobs(self.cache, artifacts, found)
+                    sec_stats = dict(getattr(self.secret_scanner,
+                                             "stats", {}))
+                    # re-merge EVERY artifact: a patched blob may be
+                    # shared with artifacts whose own `collected` is
+                    # empty (fleets share layers — the cached-layer
+                    # case), and their prepare() ran before the
+                    # patch landed. Nothing found → nothing patched
+                    # → prepare()'s merge already stands.
+                    if found:
+                        for a, p in zip(artifacts, prepared):
+                            blobs = [self.cache.get_blob(b)
+                                     for b in a.reference.blob_ids]
+                            p.detail.secrets = \
+                                merge_layer_secrets(blobs)
         secret_s += _time.perf_counter() - t0
         for sp in dev_spans.values():
             sp.end()

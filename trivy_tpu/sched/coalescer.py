@@ -63,6 +63,10 @@ class SchedConfig:
     # per-tenant weights, quotas, and rate limits. None = one
     # unlimited anonymous tenant, i.e. the old single-FIFO behavior
     tenancy: object = None
+    # service-level objectives (obs/slo.py): a list of SLO
+    # declarations the scheduler's burn-rate engine evaluates
+    # (--slo-config). None = the default availability/latency pair
+    slos: object = None
 
 
 @dataclass

@@ -41,16 +41,25 @@ class LatencyHistogram:
         self.total = 0
         self.sum = 0.0
         self.max = 0.0
+        # bucket index -> (exemplar id, value, unix seconds): the
+        # most recent trace id observed into each bucket, attached
+        # as an OpenMetrics exemplar so a slow-bucket scrape links
+        # straight to a representative trace (obs/prom.py renders
+        # them only on the openmetrics content type)
+        self.exemplars: dict = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str = "") -> None:
         # bisect_left finds the first bound >= v, i.e. the same
         # bucket the old `v <= b` scan chose; values past the last
         # bound land in the overflow slot
-        self.counts[bisect_left(self.BOUNDS, v)] += 1
+        i = bisect_left(self.BOUNDS, v)
+        self.counts[i] += 1
         self.total += 1
         self.sum += v
         if v > self.max:
             self.max = v
+        if exemplar:
+            self.exemplars[i] = (exemplar, v, time.time())
 
     def quantile(self, q: float) -> float:
         if not self.total:
@@ -77,6 +86,14 @@ class LatencyHistogram:
             "p99_s": round(self.quantile(0.99), 6),
             "max_s": round(self.max, 6),
         }
+
+    def raw(self) -> dict:
+        """The exposition shape (obs/prom.py): raw bucket counts
+        plus the per-bucket exemplars."""
+        return {"bounds": list(self.BOUNDS),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.total,
+                "exemplars": dict(self.exemplars)}
 
 
 class SchedMetrics:
@@ -115,9 +132,10 @@ class SchedMetrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
-    def observe(self, phase: str, seconds: float) -> None:
+    def observe(self, phase: str, seconds: float,
+                trace_id: str = "") -> None:
         with self._lock:
-            self.hist[phase].observe(seconds)
+            self.hist[phase].observe(seconds, exemplar=trace_id)
 
     def in_flight(self) -> int:
         """Admitted but unresolved requests (drain watches this)."""
@@ -192,10 +210,7 @@ class SchedMetrics:
         (trivy_tpu/obs/prom.py) — the JSON snapshot only carries the
         derived quantiles."""
         with self._lock:
-            return {p: {"bounds": list(h.BOUNDS),
-                        "counts": list(h.counts),
-                        "sum": h.sum, "count": h.total}
-                    for p, h in self.hist.items()}
+            return {p: h.raw() for p, h in self.hist.items()}
 
     def snapshot(self) -> dict:
         # the live queue-depth gauge is called OUTSIDE self._lock:
@@ -259,4 +274,9 @@ class SchedMetrics:
         # counts, DFA table upload amortization
         from ..secret.metrics import SECRET_METRICS
         out["secret"] = SECRET_METRICS.snapshot()
+        # device-residency accounting: live HBM bytes + generation
+        # per (table, placement) — advisory DB and DFA band alike
+        # (trivy_tpu_resident_bytes on /metrics)
+        from ..db.compiled import resident_snapshot
+        out["resident"] = resident_snapshot()
         return out

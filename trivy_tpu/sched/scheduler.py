@@ -69,7 +69,7 @@ class ScanScheduler:
 
     def __init__(self, config: Optional[SchedConfig] = None,
                  backend: str = "tpu", mesh=None,
-                 secret_scanner=None, tracer=None):
+                 secret_scanner=None, tracer=None, slo=None):
         self.config = config or SchedConfig()
         self.backend = backend
         self.mesh = mesh
@@ -81,6 +81,23 @@ class ScanScheduler:
         # tracer: trivy_tpu.obs.Tracer — every admitted request gets
         # a root span with per-stage children (docs/observability.md)
         self.tracer = tracer if tracer is not None else get_tracer()
+        # slo: trivy_tpu.obs.SloEngine — burn-rate verdicts over the
+        # admitted-request outcomes (GET /slo, trivy_tpu_slo_*
+        # gauges); a tripped burn rate auto-dumps its worst recent
+        # traces through this tracer's flight recorder. Pass a
+        # configured engine (--slo-config) or let the defaults ride.
+        if slo is None:
+            from ..obs.slo import SloEngine, parse_slo_config
+            cfg_slos = getattr(self.config, "slos", None)
+            if cfg_slos is not None:
+                # accept the --slo-config string grammar here too —
+                # one parser, and a typo'd objective fails with its
+                # ValueError instead of an AttributeError deep in
+                # SloEngine
+                cfg_slos = parse_slo_config(cfg_slos)
+            slo = SloEngine(cfg_slos,
+                            recorder=self.tracer.recorder)
+        self.slo = slo
         self.metrics = SchedMetrics()
         # tenancy-aware admission (sched/tenant.py): with the default
         # (no TenancyConfig) this is exactly the old bounded FIFO —
@@ -243,6 +260,9 @@ class ScanScheduler:
         # "Multi-tenant QoS"): queue depth, in-flight, admission and
         # shed counters, latency quantiles — the autoscaling signal
         out["tenants"] = self.queue.tenant_snapshot()
+        # SLO verdicts (obs/slo.py): burn rates over the outcome
+        # stream — the autoscaling/alerting signal GET /slo serves
+        out["slo"] = self.slo.snapshot()
         with self._lock:
             out["interval_kernel_s"] = round(self._kernel_s, 4)
         return out
@@ -300,19 +320,30 @@ class ScanScheduler:
             root.set("faults", len(req.faults))
         root.end(status)
 
+    def _note_slo(self, req: ScanRequest, outcome: str,
+                  latency: float) -> None:
+        self.slo.record(outcome, latency_s=latency,
+                        tenant=getattr(req, "tenant", "") or "",
+                        priority=int(getattr(req, "priority", 0)
+                                     or 0),
+                        trace_id=req.trace_id or "")
+
     def _complete(self, req: ScanRequest, result) -> None:
         self._clear_blob_writes(req)
         if req.set_result(result):
             latency = time.monotonic() - req.submitted_at
             self.metrics.inc("completed")
-            self.metrics.observe("request", latency)
+            self.metrics.observe("request", latency,
+                                 trace_id=req.trace_id or "")
             status = "degraded" if req.faults else "ok"
             self.queue.note_done(req, status, latency)
             self._end_trace(req, status)
+            self._note_slo(req, status, latency)
 
     def _fail(self, req: ScanRequest, err: BaseException) -> None:
         self._clear_blob_writes(req)
         if req.set_error(err):
+            latency = time.monotonic() - req.submitted_at
             if isinstance(err, DeadlineExceeded):
                 outcome = "timed_out"
             elif isinstance(err, RequestCancelled):
@@ -322,6 +353,7 @@ class ScanScheduler:
             self.metrics.inc(outcome)
             self.queue.note_done(req, outcome)
             self._end_trace(req, "failed", err)
+            self._note_slo(req, outcome, latency)
 
     def _sweep(self, req: ScanRequest) -> bool:
         """True if the request is dead (expired/cancelled) and was
@@ -357,7 +389,8 @@ class ScanScheduler:
             if req.span_queue is not None:
                 req.span_queue.end()
             self.metrics.observe(
-                "queue_wait", time.monotonic() - req.submitted_at)
+                "queue_wait", time.monotonic() - req.submitted_at,
+                trace_id=req.trace_id or "")
             if self._sweep(req):
                 continue
             with self._cv:

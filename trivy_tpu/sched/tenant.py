@@ -249,21 +249,19 @@ class TenantBook:
             slot = self._slot(tenant)
             slot[event] = slot.get(event, 0) + n
 
-    def observe(self, tenant: str, seconds: float) -> None:
+    def observe(self, tenant: str, seconds: float,
+                trace_id: str = "") -> None:
         with self._lock:
             h = self._hist.get(tenant)
             if h is None:
                 h = self._hist[tenant] = LatencyHistogram()
-            h.observe(seconds)
+            h.observe(seconds, exemplar=trace_id)
 
     def hist_snapshot(self) -> dict:
         """Raw per-tenant bucket counts for the Prometheus
-        histogram family (obs/prom.py)."""
+        histogram family (obs/prom.py), with trace-id exemplars."""
         with self._lock:
-            return {t: {"bounds": list(h.BOUNDS),
-                        "counts": list(h.counts),
-                        "sum": h.sum, "count": h.total}
-                    for t, h in self._hist.items()}
+            return {t: h.raw() for t, h in self._hist.items()}
 
     def snapshot(self, live: Optional[dict] = None) -> dict:
         """``{tenant: {counters, shed, latency, [depth/inflight/
@@ -471,7 +469,9 @@ class TenantQueue:
                 sub.inflight -= 1
         self.book.inc(tenant, outcome)
         if latency_s is not None:
-            self.book.observe(tenant, latency_s)
+            self.book.observe(tenant, latency_s,
+                              trace_id=getattr(req, "trace_id",
+                                               "") or "")
 
     # --- introspection ---
 

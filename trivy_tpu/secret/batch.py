@@ -267,6 +267,7 @@ class BatchSecretScanner:
         import time as _time
 
         from .metrics import SECRET_METRICS
+        from ..obs.trace import phase_span
         entries = handle["entries"]
         t0 = _time.perf_counter()
         candidates = self._decode(handle)
@@ -275,26 +276,32 @@ class BatchSecretScanner:
         t0 = _time.perf_counter()
         results = []
         rules_verified = windowed = wholefile = 0
-        for fe in entries:
-            chosen = candidates.get(fe.index)
-            if not chosen:
-                continue
-            rules_verified += len(chosen)
-            idxs = sorted(chosen)
-            rules = [self.scanner.rules[i] for i in idxs]
-            regions = [chosen[i] for i in idxs]
-            sub = Scanner(rules, self.scanner.allow_rules,
-                          self.scanner.exclude_block)
-            secret = sub.scan(fe.path, fe.content, regions=regions)
-            # count AFTER the scan: multibyte files silently fall
-            # back whole-file inside Scanner.scan
-            if getattr(sub, "used_regions", False):
-                windowed += sum(1 for r in regions if r is not None)
-                wholefile += sum(1 for r in regions if r is None)
-            else:
-                wholefile += len(regions)
-            if secret.findings:
-                results.append((fe.index, secret))
+        # the verify tail is a collect-side host phase: the timeline
+        # attributes device idle under it to collect_bound
+        with phase_span("verify", files=len(entries)):
+            for fe in entries:
+                chosen = candidates.get(fe.index)
+                if not chosen:
+                    continue
+                rules_verified += len(chosen)
+                idxs = sorted(chosen)
+                rules = [self.scanner.rules[i] for i in idxs]
+                regions = [chosen[i] for i in idxs]
+                sub = Scanner(rules, self.scanner.allow_rules,
+                              self.scanner.exclude_block)
+                secret = sub.scan(fe.path, fe.content,
+                                  regions=regions)
+                # count AFTER the scan: multibyte files silently
+                # fall back whole-file inside Scanner.scan
+                if getattr(sub, "used_regions", False):
+                    windowed += sum(1 for r in regions
+                                    if r is not None)
+                    wholefile += sum(1 for r in regions
+                                     if r is None)
+                else:
+                    wholefile += len(regions)
+                if secret.findings:
+                    results.append((fe.index, secret))
         verify_s = _time.perf_counter() - t0
 
         self.stats = {
@@ -336,7 +343,12 @@ class BatchSecretScanner:
             if not metas:
                 handle["mode"] = "empty"
                 return handle
-            with phase_span("dfa_scan", files=len(entries),
+            # start() is host work: shard layout + pool-parallel
+            # segment fills, then a NON-blocking mesh enqueue — so
+            # it brackets as pack, not device-busy; the dfa_scan
+            # busy span lives at ShardedSieve.decode()'s join,
+            # where the device wall actually passes
+            with phase_span("pack", files=len(entries),
                             shards=self._shard_count()):
                 sharded = ShardedSieve(self, metas)
                 sharded.start()
@@ -358,7 +370,14 @@ class BatchSecretScanner:
         if self.backend == "cpu-ref":
             t0 = _time.perf_counter()
             from ..ops.dfa import dfa_masks_host
-            handle["masks"] = dfa_masks_host(buf, self.table)
+            # the host kernel IS the sieve compute on this path —
+            # bracketed as dfa_scan so the timeline counts it busy
+            # (the fused path's span lives at its fetch instead,
+            # where the async dispatch's wall actually passes)
+            with phase_span("dfa_scan", segments=int(buf.shape[0]),
+                            patterns=self.table.n_patterns,
+                            host=True):
+                handle["masks"] = dfa_masks_host(buf, self.table)
             handle["mode"] = "host"
             handle["device_s"] += _time.perf_counter() - t0
             return handle
@@ -390,13 +409,16 @@ class BatchSecretScanner:
         extraction-exact (the host then regexes only those spans); to
         None when it needs the reference's whole-file scan."""
         import time as _time
+
+        from ..obs.trace import phase_span
         if handle["mode"] == "empty":
             return {}
         entries = handle["entries"]
 
         if handle["mode"] == "sharded":
             t0 = _time.perf_counter()
-            file_codes, runs_map = handle["sharded"].decode()
+            with phase_span("decode", mode="sharded"):
+                file_codes, runs_map = handle["sharded"].decode()
             handle["device_s"] += handle["sharded"].device_s
             handle["pack_s"] = handle["sharded"].pack_s
             handle["decode_s"] = _time.perf_counter() - t0
@@ -413,31 +435,41 @@ class BatchSecretScanner:
         run_fetch = None
         t0 = _time.perf_counter()
         if handle["mode"] == "host":
+            # the host kernel already ran (and was bracketed) at
+            # dispatch; this nonzero walk is plain decode work and
+            # must NOT count as device-busy
             masks = handle["masks"]
             seg_nz, code_nz = np.nonzero(masks)
             hit_vals = masks[seg_nz, code_nz]
         else:
-            B = buf.shape[0]
-            K = self.table.n_patterns
-            nhit = int(handle["nhit"])
-            cm = handle["cm"]
-            h = handle["h"]
-            if nhit > min(cm.shape[0], handle["dev"].shape[0]):
-                # fetch the full mask array; run hits (h) were
-                # already computed by the fused dispatch
-                full = self.table.full_sieve((), handle["platform"])
-                m, _ = full(handle["dev"], *handle["tbl"])
-                masks = np.asarray(m)[:B, :K]
-                seg_nz, code_nz = np.nonzero(masks)
-                hit_vals = masks[seg_nz, code_nz]
-            else:
-                rows = np.asarray(cm)[:nhit, :K]
-                ridx = np.asarray(handle["idx"])[:nhit]
-                rnz, code_nz = np.nonzero(rows)
-                # padded rows (index ≥ B) never hit: zero segments
-                seg_nz = ridx[rnz]
-                hit_vals = rows[rnz, code_nz]
-            run_fetch = np.asarray(h)[:B]
+            # the result fetch is where the async dispatch's device
+            # wall actually passes (materializing the jax arrays
+            # blocks on the computation) — bracketed as dfa_scan so
+            # the timeline counts it as device-busy, not collect work
+            with phase_span("dfa_scan", fetch=True):
+                B = buf.shape[0]
+                K = self.table.n_patterns
+                nhit = int(handle["nhit"])
+                cm = handle["cm"]
+                h = handle["h"]
+                if nhit > min(cm.shape[0], handle["dev"].shape[0]):
+                    # fetch the full mask array; run hits (h) were
+                    # already computed by the fused dispatch
+                    full = self.table.full_sieve(
+                        (), handle["platform"])
+                    m, _ = full(handle["dev"], *handle["tbl"])
+                    masks = np.asarray(m)[:B, :K]
+                    seg_nz, code_nz = np.nonzero(masks)
+                    hit_vals = masks[seg_nz, code_nz]
+                else:
+                    rows = np.asarray(cm)[:nhit, :K]
+                    ridx = np.asarray(handle["idx"])[:nhit]
+                    rnz, code_nz = np.nonzero(rows)
+                    # padded rows (index ≥ B) never hit: zero
+                    # segments
+                    seg_nz = ridx[rnz]
+                    hit_vals = rows[rnz, code_nz]
+                run_fetch = np.asarray(h)[:B]
         handle["device_s"] += _time.perf_counter() - t0
 
         # run-hits decode is lazy: it happens at most once per batch,
@@ -461,15 +493,19 @@ class BatchSecretScanner:
 
         # per file: pattern column → merged list of
         # (segment file-offset, bitmask)
-        file_codes: dict = {}
-        for si, ci, mv in zip(seg_nz.tolist(), code_nz.tolist(),
-                              hit_vals.tolist()):
-            if seg_file[si] < 0:
-                continue                  # shard-padding row
-            fc = file_codes.setdefault(seg_file[si], {})
-            fc.setdefault(ci, []).append((seg_pos[si], int(mv)))
+        with phase_span("decode", mode=handle["mode"]):
+            file_codes: dict = {}
+            for si, ci, mv in zip(seg_nz.tolist(),
+                                  code_nz.tolist(),
+                                  hit_vals.tolist()):
+                if seg_file[si] < 0:
+                    continue              # shard-padding row
+                fc = file_codes.setdefault(seg_file[si], {})
+                fc.setdefault(ci, []).append((seg_pos[si],
+                                              int(mv)))
 
-        return self._choose(handle, entries, file_codes, file_runs)
+            return self._choose(handle, entries, file_codes,
+                                file_runs)
 
     def _choose(self, handle: dict, entries: list, file_codes: dict,
                 file_runs) -> dict:
