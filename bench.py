@@ -176,9 +176,36 @@ def _layer_tar(files: dict) -> bytes:
     return buf.getvalue()
 
 
-def make_fleet(tmpdir: str, n_images: int) -> list:
+def _image_tar(tmpdir: str, filename: str, tag: str,
+               layers: list) -> str:
+    """One docker-save image tar from per-layer file dicts — the
+    single image builder every fleet-shaped bench arm goes through."""
     import hashlib
     import os
+    blobs = [_layer_tar(f) for f in layers]
+    diff_ids = ["sha256:" + hashlib.sha256(b).hexdigest()
+                for b in blobs]
+    config = {"architecture": "amd64", "os": "linux",
+              "rootfs": {"type": "layers", "diff_ids": diff_ids},
+              "config": {}}
+    manifest = [{"Config": "config.json",
+                 "RepoTags": [tag],
+                 "Layers": [f"l{i}.tar"
+                            for i in range(len(blobs))]}]
+    path = os.path.join(tmpdir, filename)
+    with tarfile.open(path, "w") as tf:
+        def add(name, data):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+        add("config.json", json.dumps(config).encode())
+        add("manifest.json", json.dumps(manifest).encode())
+        for i, b in enumerate(blobs):
+            add(f"l{i}.tar", b)
+    return path
+
+
+def make_fleet(tmpdir: str, n_images: int) -> list:
     rng = np.random.default_rng(20260730)
     paths = []
     for n in range(n_images):
@@ -200,27 +227,8 @@ def make_fleet(tmpdir: str, n_images: int) -> list:
                 files[f"srv/app{li}/{name}"] = body
             layers.append(files)
 
-        blobs = [_layer_tar(f) for f in layers]
-        diff_ids = ["sha256:" + hashlib.sha256(b).hexdigest()
-                    for b in blobs]
-        config = {"architecture": "amd64", "os": "linux",
-                  "rootfs": {"type": "layers", "diff_ids": diff_ids},
-                  "config": {}}
-        manifest = [{"Config": "config.json",
-                     "RepoTags": [f"bench/img:{n}"],
-                     "Layers": [f"l{i}.tar"
-                                for i in range(len(blobs))]}]
-        path = os.path.join(tmpdir, f"img{n}.tar")
-        with tarfile.open(path, "w") as tf:
-            def add(name, data):
-                ti = tarfile.TarInfo(name)
-                ti.size = len(data)
-                tf.addfile(ti, io.BytesIO(data))
-            add("config.json", json.dumps(config).encode())
-            add("manifest.json", json.dumps(manifest).encode())
-            for i, b in enumerate(blobs):
-                add(f"l{i}.tar", b)
-        paths.append(path)
+        paths.append(_image_tar(tmpdir, f"img{n}.tar",
+                                f"bench/img:{n}", layers))
     return paths
 
 
@@ -473,6 +481,234 @@ def bench_images() -> dict:
             },
             "findings": {"vulns": n_vulns, "secrets": n_secrets},
             "idle_attribution": timeline,
+        }
+
+
+def make_warm_fleet(tmpdir: str, n_images: int,
+                    reuse: float = 0.8) -> tuple:
+    """(cold paths, warm paths): a base fleet plus a second fleet of
+    NEW image combinations whose layers are ``reuse``-fraction drawn
+    from the base fleet's layer pool — the registry-traffic shape
+    (same base layers across thousands of images). Returns docker-
+    save tarballs via the same builder as make_fleet."""
+    rng = np.random.default_rng(20260804)
+    # layer pool: a handful of apk (os) layers + many source layers
+    apk_layers = []
+    for v in range(4):
+        apk = "".join(
+            APK_TEMPLATE.format(i=i, minor=v, patch=i % 9,
+                                rev=i % 4)
+            for i in range(60))
+        apk_layers.append({"etc/alpine-release": b"3.16.2\n",
+                           "lib/apk/db/installed": apk.encode()})
+    src_pool = []
+    for k in range(n_images):
+        files = {}
+        for fi in range(FILES_PER_LAYER):
+            name, body = _source_file(rng, fi)
+            if (k + fi) % 29 == 0:
+                body += SECRETS[(k + fi) % len(SECRETS)]
+            files[f"srv/app{k % 7}/{name}"] = body
+        src_pool.append(files)
+
+    def build(prefix: str, fresh_tag: int) -> list:
+        paths = []
+        for n in range(n_images):
+            layers = [apk_layers[n % len(apk_layers)]]
+            for li in range(1, LAYERS_PER_IMAGE):
+                if float(rng.random()) < reuse:
+                    layers.append(src_pool[
+                        int(rng.integers(0, len(src_pool)))])
+                else:
+                    files = {}
+                    for fi in range(FILES_PER_LAYER):
+                        name, body = _source_file(rng, fi)
+                        files[f"srv/novel{fresh_tag}/{n}/{name}"] \
+                            = body
+                    layers.append(files)
+            paths.append(_image_tar(tmpdir, f"{prefix}{n}.tar",
+                                    f"bench/{prefix}:{n}", layers))
+        return paths
+
+    return build("cold", 0), build("warm", 1)
+
+
+def _warm_stores():
+    """Two compiled generations: gen2 changes a slice of the alpine
+    advisories (new fixed versions + one new advisory) so the
+    hot-swap arm has a real delta to re-match."""
+    from trivy_tpu.db import CompiledDB
+    store = make_store()
+    cdb1 = CompiledDB.compile(store)
+    for i in range(0, 40, 8):          # touch 5 of 40 packages
+        store.put_advisory(
+            "alpine 3.16", f"pkg{i}", f"CVE-2022-{10000 + i}",
+            {"FixedVersion": f"9.{i % 7}.9-r0"})
+    store.put_advisory("alpine 3.16", "pkg1", "CVE-2024-77777",
+                       {"FixedVersion": "1.0.2-r0"})
+    store.put_vulnerability("CVE-2024-77777",
+                            {"Severity": "CRITICAL",
+                             "Title": "hot-swap arm advisory"})
+    cdb2 = CompiledDB.compile(store)
+    return cdb1, cdb2
+
+
+def bench_fleet_warm() -> dict:
+    """``--config fleet-warm`` (docs/performance.md "Findings
+    memoization & incremental re-scan"): a 512-image fleet at 80%
+    layer reuse, scanned cold, then warm through the findings memo;
+    a ``db update`` hot-swap arm re-matches only the advisory
+    delta; a cache-outage arm proves the memo degrades to recompute.
+
+    Gates: warm ≥ 3× cold throughput, warm/cold reports
+    byte-identical, hot-swap re-matched jobs < 25% of a full
+    re-scan's, hot-swap warm scan byte-identical to a cold scan at
+    the new generation, outage arm completes ok byte-identical."""
+    import os
+    import tempfile
+
+    from trivy_tpu.artifact.cache import MemoryCache
+    from trivy_tpu.db.compiled import SwappableStore
+    from trivy_tpu.db.lifecycle import attach_memo
+    from trivy_tpu.faults import FaultInjector, parse_fault_spec
+    from trivy_tpu.memo import FindingsMemo, MemoryMemoStore
+    from trivy_tpu.memo.metrics import MEMO_METRICS
+    from trivy_tpu.runtime import BatchScanRunner
+
+    n_images = int(os.environ.get("WARM_FLEET_IMAGES", N_IMAGES))
+    with tempfile.TemporaryDirectory() as tmp:
+        cold_paths, warm_paths = make_warm_fleet(tmp, n_images)
+        cdb1, cdb2 = _warm_stores()
+
+        # XLA warm-up at fleet shape (same rationale as bench_images)
+        BatchScanRunner(store=cdb1,
+                        backend="tpu").scan_paths(cold_paths)
+
+        # ---- arm 1: cold fleet (fresh cache, fresh memo) ----
+        memo = FindingsMemo(MemoryMemoStore(), backend="tpu")
+        cache = MemoryCache()
+        runner = BatchScanRunner(store=cdb1, cache=cache,
+                                 backend="tpu", memo=memo)
+        t0 = time.perf_counter()
+        runner.scan_paths(cold_paths)
+        cold_s = time.perf_counter() - t0
+        cold_stats = runner.last_stats
+
+        # ---- arm 2: the warm fleet, twice ----
+        # pass 1 primes the 20% novel layers; pass 2 is the steady
+        # re-scan state production sees (same images re-scanned
+        # after a push): blob cache + memo both warm
+        m0 = MEMO_METRICS.snapshot()
+        runner.scan_paths(warm_paths)
+        m1 = MEMO_METRICS.snapshot()
+        first_hits = m1["hits"] - m0["hits"]
+        assert first_hits > 0, \
+            "80%-reused fleet must memo-hit on first sight"
+        t0 = time.perf_counter()
+        warm_results = runner.scan_paths(warm_paths)
+        warm_s = time.perf_counter() - t0
+        warm_stats = runner.last_stats
+        m2 = MEMO_METRICS.snapshot()
+        assert warm_stats["interval_jobs"] == 0, \
+            "steady warm re-scan must dispatch nothing"
+
+        # byte-identity: warm results == a cold scan of the same
+        # fleet with no cache and no memo
+        cold_ref = BatchScanRunner(
+            store=cdb1, backend="tpu").scan_paths(warm_paths)
+        assert _norm(cold_ref) == _norm(warm_results), \
+            "warm-path report diverges from cold path"
+
+        speedup = cold_s / warm_s if warm_s else float("inf")
+        floor = float(os.environ.get("WARM_GATE_SPEEDUP", "3.0"))
+        assert speedup >= floor, \
+            f"warm fleet only {speedup:.2f}x cold (floor {floor}x)"
+
+        # ---- arm 3: db update hot swap + delta re-match ----
+        sw = SwappableStore(cdb1)
+        attach_memo(sw, memo)
+        t0 = time.perf_counter()
+        sw.swap(cdb2, stage=False)
+        swap_s = time.perf_counter() - t0
+        m3 = MEMO_METRICS.snapshot()
+        rematch_jobs = m3["rematch_jobs"] - m2["rematch_jobs"]
+
+        runner2 = BatchScanRunner(store=cdb2, cache=cache,
+                                  backend="tpu", memo=memo)
+        t0 = time.perf_counter()
+        post_swap = runner2.scan_paths(warm_paths)
+        post_swap_s = time.perf_counter() - t0
+        m4 = MEMO_METRICS.snapshot()
+        post_missed = m4["misses"] - m3["misses"]
+
+        cold2_runner = BatchScanRunner(store=cdb2, backend="tpu")
+        cold2 = cold2_runner.scan_paths(warm_paths)
+        cold2_jobs = cold2_runner.last_stats["interval_jobs"]
+        assert _norm(cold2) == _norm(post_swap), \
+            "post-hot-swap report diverges from full cold re-scan"
+        rematch_cap = float(os.environ.get("REMATCH_GATE", "0.25"))
+        rematched = rematch_jobs + \
+            (runner2.last_stats["interval_jobs"] or 0)
+        assert rematched < rematch_cap * cold2_jobs, \
+            f"delta re-match dispatched {rematched} jobs " \
+            f"(cold scan: {cold2_jobs}; cap {rematch_cap:.0%})"
+
+        # ---- arm 4: cache outage — memo rides the breaker ----
+        inj = FaultInjector(parse_fault_spec(
+            "cache-outage:cache_fail_ops=-1"))
+        memo_out = FindingsMemo(MemoryMemoStore(),
+                                fault_injector=inj, backend="tpu")
+        outage_paths = warm_paths[:64]
+        outage = BatchScanRunner(store=cdb1, backend="tpu",
+                                 memo=memo_out).scan_paths(
+                                     outage_paths)
+        assert all(r.status == "ok" for r in outage), \
+            "memo outage must degrade to recompute, not errors"
+        ref = BatchScanRunner(store=cdb1, backend="tpu").scan_paths(
+            outage_paths)
+        assert _norm(ref) == _norm(outage), \
+            "outage-arm findings diverge"
+        breaker = memo_out.stats()["backend"]
+
+        lookups = (m2["hits"] - m0["hits"]) + \
+            (m2["misses"] - m0["misses"])
+        return {
+            "images": n_images,
+            "layer_reuse": 0.8,
+            "cold_s": round(cold_s, 2),
+            "warm_s": round(warm_s, 2),
+            "cold_images_per_sec": round(n_images / cold_s, 2),
+            "warm_images_per_sec": round(n_images / warm_s, 2),
+            "warm_speedup": round(speedup, 2),
+            "memo": {
+                "first_sight_hits": first_hits,
+                "steady_hits": m2["hits"] - m1["hits"],
+                "hit_rate": round(
+                    (m2["hits"] - m0["hits"]) / lookups, 4)
+                if lookups else 0.0,
+                "stores": m2["stores"] - m0["stores"],
+                "bytes": m2["bytes"] - m0["bytes"],
+            },
+            "db_update": {
+                "swap_s": round(swap_s, 4),
+                "rematch_jobs": rematch_jobs,
+                "post_swap_scan_s": round(post_swap_s, 2),
+                "post_swap_misses": post_missed,
+                "cold_rescan_jobs": cold2_jobs,
+                "rematch_job_share": round(
+                    rematched / cold2_jobs, 4) if cold2_jobs
+                else 0.0,
+                "invalidated_subs": m3["invalidations"] -
+                m2["invalidations"],
+                "migrated_entries": m3["migrated_entries"] -
+                m2["migrated_entries"],
+            },
+            "outage": {
+                "images": len(outage_paths),
+                "status_ok": True,
+                "breaker": breaker["breaker"]["state"],
+                "primary_errors": breaker["primary_errors"],
+            },
         }
 
 
@@ -1541,7 +1777,8 @@ def _run_config(cfg: str) -> dict:
             "faults": bench_faults,
             "hostile": bench_hostile,
             "obs": bench_obs,
-            "timeline": bench_timeline}[cfg]()
+            "timeline": bench_timeline,
+            "fleet-warm": bench_fleet_warm}[cfg]()
 
 
 def _subprocess_config(cfg: str) -> dict:
@@ -1590,6 +1827,7 @@ def main() -> None:
     hostile = _subprocess_config("hostile")
     obs = _subprocess_config("obs")
     timeline = _subprocess_config("timeline")
+    fleet_warm = _subprocess_config("fleet-warm")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -1617,6 +1855,7 @@ def main() -> None:
         "hostile": hostile,
         "obs": obs,
         "timeline": timeline,
+        "fleet_warm": fleet_warm,
     }))
 
 

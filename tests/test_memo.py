@@ -1,0 +1,470 @@
+"""Findings-memo suite (``pytest -m memo``, docs/performance.md
+"Findings memoization & incremental re-scan").
+
+Covers the hit/miss partition on both execution paths, key-anatomy
+isolation (guard config / secret rule set never share entries), the
+memo-poison and cache-outage fault drills (checksum drop + breaker
+recompute, scans stay ok and byte-identical), cross-image base-layer
+sharing, and the metrics/observability surfaces.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from trivy_tpu.memo import (FindingsMemo, MemoryMemoStore,
+                            ResilientMemoStore)
+from trivy_tpu.memo.metrics import MEMO_METRICS
+from trivy_tpu.runtime import BatchScanRunner
+from trivy_tpu.utils.synth import tiny_fleet, write_image_tar
+
+pytestmark = pytest.mark.memo
+
+
+def _norm(results):
+    out = []
+    for r in results:
+        if r.error:
+            out.append((r.name, "error", r.error))
+        else:
+            out.append((r.name, r.status,
+                        json.dumps(r.report.to_dict(),
+                                   sort_keys=True)))
+    return out
+
+
+def _snap():
+    return MEMO_METRICS.snapshot()
+
+
+def _delta(before, after, key):
+    return after[key] - before[key]
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    return tiny_fleet(str(tmp_path), 4)
+
+
+# ---------------------------------------------------------------- hits
+
+@pytest.mark.parametrize("sched", ["off", "on"])
+def test_warm_rescan_byte_identical_and_dispatch_free(fleet, sched):
+    """A warm re-scan serves every verdict from the memo — zero
+    interval jobs dispatched — and its reports are byte-identical
+    to the cold (memo-less) run, on BOTH execution paths."""
+    paths, store = fleet
+    base = BatchScanRunner(store=store,
+                           backend="cpu-ref").scan_paths(paths)
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    before = _snap()
+    r1 = BatchScanRunner(store=store, backend="cpu-ref",
+                         memo=memo, sched=sched)
+    cold = r1.scan_paths(paths)
+    r1.close()
+    mid = _snap()
+    assert _delta(before, mid, "misses") > 0
+    assert _delta(before, mid, "hits") == 0
+    assert _delta(before, mid, "stores") > 0
+
+    # fresh blob cache, same memo: analysis reruns, detection hits
+    r2 = BatchScanRunner(store=store, backend="cpu-ref",
+                         memo=memo, sched=sched)
+    warm = r2.scan_paths(paths)
+    r2.close()
+    after = _snap()
+    assert _delta(mid, after, "hits") == _delta(before, mid,
+                                                "misses")
+    assert _delta(mid, after, "misses") == 0
+    if sched == "off":
+        assert r2.last_stats["interval_jobs"] == 0
+    assert _norm(base) == _norm(cold) == _norm(warm)
+
+
+def test_shared_base_layer_hits_across_images(tmp_path):
+    """Fleets share base layers: an image never scanned before still
+    memo-hits every layer it shares with a previously scanned one
+    (the registry-traffic case the subsystem exists for)."""
+    paths, store = tiny_fleet(str(tmp_path), 2)
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo).scan_paths(paths)
+    # new image: same apk (base) layer bytes as image 0, fresh top
+    import tarfile
+    with tarfile.open(paths[0]) as tf:
+        base_layer = {}
+        inner = tarfile.open(fileobj=tf.extractfile("l0.tar"))
+        for m in inner.getmembers():
+            base_layer[m.name] = inner.extractfile(m).read()
+    novel = str(tmp_path / "novel.tar")
+    write_image_tar(novel, [base_layer,
+                            {"srv/new/app.env": b"MODE=prod\n"}],
+                    repo_tag="novel:latest")
+    before = _snap()
+    r = BatchScanRunner(store=store, backend="cpu-ref", memo=memo)
+    warm = r.scan_paths([novel])
+    after = _snap()
+    assert _delta(before, after, "hits") > 0          # base layer
+    cold = BatchScanRunner(store=store,
+                           backend="cpu-ref").scan_paths([novel])
+    assert _norm(cold) == _norm(warm)
+
+
+def test_sbom_single_blob_memoization(tmp_path):
+    """SBOM scans are single-blob targets: the whole document's
+    verdicts memoize under its content-addressed blob id."""
+    from trivy_tpu.db import AdvisoryStore
+    store = AdvisoryStore()
+    store.put_advisory("npm::Node.js", "lodash", "CVE-2021-1",
+                       {"VulnerableVersions": ["<4.17.21"],
+                        "PatchedVersions": [">=4.17.21"]})
+    store.put_vulnerability("CVE-2021-1", {"Severity": "HIGH"})
+    doc = json.dumps({
+        "bomFormat": "CycloneDX", "specVersion": "1.4",
+        "version": 1,
+        "components": [{"bom-ref": "a", "type": "library",
+                        "name": "lodash", "version": "4.17.20",
+                        "purl": "pkg:npm/lodash@4.17.20"}],
+    }).encode()
+    boms = [("app.cdx.json", doc)]
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    base = BatchScanRunner(store=store,
+                           backend="cpu-ref").scan_boms(boms)
+    before = _snap()
+    r1 = BatchScanRunner(store=store, backend="cpu-ref", memo=memo)
+    cold = r1.scan_boms(boms)
+    r2 = BatchScanRunner(store=store, backend="cpu-ref", memo=memo)
+    warm = r2.scan_boms(boms)
+    after = _snap()
+    assert _delta(before, after, "hits") > 0
+    assert r2.last_stats["interval_jobs"] == 0
+    assert _norm(base) == _norm(cold) == _norm(warm)
+
+
+# ------------------------------------------------------- key isolation
+
+def test_guard_config_never_shares_entries(fleet):
+    """Satellite: two ingest-guard configs must never share a memo
+    entry — the guard hash is a key component, so the second config
+    misses even against a store the first one filled."""
+    paths, store = fleet
+    shared = MemoryMemoStore()
+    memo_a = FindingsMemo(shared, guard_fp="guards-on",
+                          backend="cpu-ref")
+    memo_b = FindingsMemo(shared, guard_fp="guards-off",
+                          backend="cpu-ref")
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo_a).scan_paths(paths)
+    keys_a = set(shared.keys())
+    before = _snap()
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo_b).scan_paths(paths)
+    after = _snap()
+    assert _delta(before, after, "hits") == 0
+    assert _delta(before, after, "misses") > 0
+    assert keys_a.isdisjoint(set(shared.keys()) - keys_a)
+
+
+def test_rule_set_hash_never_shares_entries(fleet):
+    """Satellite: the trivy-secret.yaml rule-set hash (ops/dfa
+    corpus) keys memo entries — custom and builtin rule sets never
+    share."""
+    paths, store = fleet
+    shared = MemoryMemoStore()
+    memo_a = FindingsMemo(shared, rules_fp="builtin-abc",
+                          backend="cpu-ref")
+    memo_b = FindingsMemo(shared, rules_fp="custom-def",
+                          backend="cpu-ref")
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo_a).scan_paths(paths)
+    before = _snap()
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo_b).scan_paths(paths)
+    after = _snap()
+    assert _delta(before, after, "hits") == 0
+    assert _delta(before, after, "misses") > 0
+
+
+def test_rules_fingerprint_distinguishes_custom_rules():
+    """The real fingerprint function: a custom rule set hashes
+    differently from the builtin corpus; the builtin hash is
+    stable."""
+    import re
+
+    from trivy_tpu.secret.batch import rules_fingerprint
+    from trivy_tpu.secret.model import Rule
+    from trivy_tpu.secret.scanner import Scanner, new_scanner
+    builtin = rules_fingerprint(None)
+    assert builtin == rules_fingerprint(new_scanner())
+    custom = Scanner(new_scanner().rules + [Rule(
+        id="custom-1", category="custom", severity="HIGH",
+        regex=re.compile(r"mysecret-[0-9a-f]{16}"),
+        keywords=["mysecret"])], [], None)
+    assert rules_fingerprint(custom) != builtin
+
+
+def test_blob_cache_keys_include_rule_set(tmp_path):
+    """The blob cache itself keys on the rule-set hash: two
+    ArtifactOptions with different fingerprints produce disjoint
+    blob ids for the same image."""
+    from trivy_tpu.artifact.artifact import (ArtifactOption,
+                                             ImageArtifact)
+    from trivy_tpu.artifact.cache import MemoryCache
+    from trivy_tpu.artifact.image import load_image
+    paths, _ = tiny_fleet(str(tmp_path), 1)
+    ids = []
+    for fp in ("rules-a", "rules-b"):
+        cache = MemoryCache()
+        art = ImageArtifact(load_image(paths[0]), cache,
+                            option=ArtifactOption(
+                                secret_rules_fp=fp))
+        ids.append(tuple(art.inspect().blob_ids))
+    assert set(ids[0]).isdisjoint(ids[1])
+
+
+# ------------------------------------------------------- fault drills
+
+def test_memo_poison_detected_dropped_recomputed(fleet):
+    """NEW memo-poison scenario: corrupted/truncated entries fail
+    the checksum on deserialize, are dropped, and recompute
+    transparently — scan completes ``status: ok``, byte-identical
+    to cold."""
+    from trivy_tpu.faults import FaultInjector, parse_fault_spec
+    paths, store = fleet
+    base = BatchScanRunner(store=store,
+                           backend="cpu-ref").scan_paths(paths)
+    # the fleet has two distinct layers → two memo entries; corrupt
+    # exactly one warm scan's worth of loads
+    inj = FaultInjector(parse_fault_spec(
+        "memo-poison:memo_corrupt_loads=2"))
+    backing = MemoryMemoStore()
+    memo = FindingsMemo(backing, fault_injector=inj,
+                        backend="cpu-ref")
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo).scan_paths(paths)       # fills store
+    before = _snap()
+    warm = BatchScanRunner(store=store, backend="cpu-ref",
+                           memo=memo).scan_paths(paths)
+    after = _snap()
+    assert inj.counters["memo_corruptions"] > 0
+    assert _delta(before, after, "corrupt") == \
+        inj.counters["memo_corruptions"]
+    assert all(r.status == "ok" for r in warm)
+    assert _norm(base) == _norm(warm)
+    # the poisoned entries were re-stored; a further scan hits clean
+    inj2 = _snap()
+    again = BatchScanRunner(store=store, backend="cpu-ref",
+                            memo=memo).scan_paths(paths)
+    assert _delta(inj2, _snap(), "misses") == 0
+    assert _norm(base) == _norm(again)
+
+
+@pytest.mark.faults
+def test_memo_rides_circuit_breaker_on_cache_outage(fleet):
+    """Acceptance: under the cache-outage scenario the memo degrades
+    to recompute behind its circuit breaker — the fleet completes
+    ``status: ok`` with byte-identical findings, no errors."""
+    from trivy_tpu.faults import FaultInjector, parse_fault_spec
+    paths, store = fleet
+    base = BatchScanRunner(store=store,
+                           backend="cpu-ref").scan_paths(paths)
+    inj = FaultInjector(parse_fault_spec(
+        "cache-outage:cache_fail_ops=-1"))
+    memo = FindingsMemo(MemoryMemoStore(), fault_injector=inj,
+                        backend="cpu-ref")
+    results = BatchScanRunner(store=store, backend="cpu-ref",
+                              memo=memo).scan_paths(paths)
+    assert all(r.status == "ok" for r in results)
+    assert _norm(base) == _norm(results)
+    stats = memo.stats()
+    assert stats["backend"]["primary_errors"] > 0
+    assert stats["backend"]["breaker"]["state"] in ("open",
+                                                    "half-open")
+
+
+def test_resilient_store_breaker_unit():
+    """Breaker mechanics on the memo store: consecutive failures
+    open the circuit (lookups answer miss without touching the
+    backend), recovery closes it."""
+    class Flaky:
+        def __init__(self):
+            self.down = True
+            self.calls = 0
+            self.d = {}
+
+        def get(self, k):
+            self.calls += 1
+            if self.down:
+                raise ConnectionError("down")
+            return self.d.get(k)
+
+        def put(self, k, v):
+            self.calls += 1
+            if self.down:
+                raise ConnectionError("down")
+            self.d[k] = v
+
+        def delete(self, k):
+            self.d.pop(k, None)
+
+        def keys(self):
+            return sorted(self.d)
+
+    from trivy_tpu.artifact.resilient import CircuitBreaker
+    clock = [0.0]
+    flaky = Flaky()
+    store = ResilientMemoStore(flaky, breaker=CircuitBreaker(
+        fail_threshold=2, cooldown_s=5.0,
+        clock=lambda: clock[0]))
+    assert store.get("k") is None
+    assert store.get("k") is None
+    assert store.breaker.state == "open"
+    calls = flaky.calls
+    assert store.get("k") is None          # open: backend untouched
+    assert flaky.calls == calls
+    flaky.down = False
+    clock[0] = 6.0                         # past cooldown: probe
+    store.put("k", b"v")
+    assert store.breaker.state == "closed"
+    assert store.get("k") == b"v"
+
+
+def test_corrupt_entry_dropped_on_disk(tmp_path, fleet):
+    """FS backend: hand-truncated entry files fail the checksum and
+    are deleted, scan stays correct."""
+    import os
+
+    from trivy_tpu.memo import FSMemoStore
+    paths, store = fleet
+    backing = FSMemoStore(str(tmp_path))
+    memo = FindingsMemo(backing, backend="cpu-ref")
+    base = BatchScanRunner(store=store, backend="cpu-ref",
+                           memo=memo).scan_paths(paths)
+    files = [os.path.join(backing.dir, f)
+             for f in os.listdir(backing.dir)]
+    assert files
+    with open(files[0], "r+b") as f:
+        f.truncate(max(4, os.path.getsize(files[0]) // 2))
+    before = _snap()
+    warm = BatchScanRunner(store=store, backend="cpu-ref",
+                           memo=memo).scan_paths(paths)
+    assert _delta(before, _snap(), "corrupt") == 1
+    assert not os.path.exists(files[0]) or \
+        os.path.getsize(files[0]) > 0      # dropped then re-stored
+    assert _norm(base) == _norm(warm)
+
+
+# ----------------------------------------------------------- surfaces
+
+@pytest.mark.obs
+def test_metrics_surfaces_json_and_prom(fleet):
+    """`trivy_tpu_memo_*` on /metrics: JSON (sched and sched-off
+    servers, SchedMetrics.snapshot) and Prometheus text."""
+    from trivy_tpu.obs.prom import render_prometheus
+    from trivy_tpu.rpc.server import ScanServer
+    from trivy_tpu.sched import ScanScheduler
+
+    paths, store = fleet
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    BatchScanRunner(store=store, backend="cpu-ref",
+                    memo=memo).scan_paths(paths)
+
+    server = ScanServer(store=store, memo=memo)
+    out = server.metrics()
+    assert "memo" in out
+    for k in ("hits", "misses", "stores", "invalidations",
+              "bytes", "hit_rate"):
+        assert k in out["memo"]
+    text = server.metrics_text()
+    for name in ("trivy_tpu_memo_hits_total",
+                 "trivy_tpu_memo_misses_total",
+                 "trivy_tpu_memo_stores_total",
+                 "trivy_tpu_memo_invalidations_total",
+                 "trivy_tpu_memo_bytes_total",
+                 "trivy_tpu_memo_hit_rate"):
+        assert name in text, name
+
+    sched = ScanScheduler()
+    try:
+        sched.start()
+        assert "memo" in sched.stats()
+    finally:
+        sched.close()
+    # plain renderer accepts a bare snapshot too
+    assert "trivy_tpu_memo_hit_rate" in render_prometheus(
+        {"memo": MEMO_METRICS.snapshot()})
+
+
+@pytest.mark.obs
+def test_memo_spans_in_timeline_taxonomy(fleet):
+    """memo_lookup / memo_store / delta_rematch are typed causes in
+    the PR-8 idle-attribution taxonomy, and real scans emit the
+    spans."""
+    from trivy_tpu.obs import FlightRecorder, Tracer
+    from trivy_tpu.obs.timeline import CAUSE_SPANS
+    cover = {n for _, names in CAUSE_SPANS for n in names}
+    assert {"memo_lookup", "memo_store",
+            "delta_rematch"} <= cover
+
+    paths, store = fleet
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    tracer = Tracer(recorder=FlightRecorder(capacity=64))
+    r = BatchScanRunner(store=store, backend="cpu-ref", memo=memo,
+                        tracer=tracer)
+    r.scan_paths(paths)
+    names = {s.name for _, trace in tracer.recorder.traces()
+             for s in trace}
+    assert "memo_lookup" in names
+    assert "memo_store" in names
+
+
+def test_server_scan_paths_use_memo(fleet):
+    """Both server scan paths (sched off here) thread the memo: a
+    repeated Scan RPC hits."""
+    from trivy_tpu.artifact.artifact import ImageArtifact
+    from trivy_tpu.artifact.image import load_image
+    from trivy_tpu.rpc.server import ScanServer
+    paths, store = fleet
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    server = ScanServer(store=store, memo=memo)
+    art = ImageArtifact(load_image(paths[0]), server.cache)
+    ref = art.inspect()
+    body = {"target": ref.name, "artifact_id": ref.id,
+            "blob_ids": ref.blob_ids,
+            "options": {"security_checks": ["vuln"],
+                        "backend": "cpu-ref"}}
+    first = server._scan(dict(body))
+    before = _snap()
+    second = server._scan(dict(body))
+    after = _snap()
+    assert _delta(before, after, "hits") > 0
+    assert json.dumps(first, sort_keys=True) == \
+        json.dumps(second, sort_keys=True)
+
+
+def test_cli_no_memo_flag(tmp_path, capsys):
+    """--no-memo runs the fleet path memo-free; default runs it
+    memo-on — outputs identical."""
+    from trivy_tpu.cli import main
+    paths, store = tiny_fleet(str(tmp_path), 2)
+    # fixture file for --db-fixtures (dbtest bucket format)
+    import yaml
+    fx = tmp_path / "fixtures.yaml"
+    fx.write_text(yaml.safe_dump([{
+        "bucket": "alpine 3.16",
+        "pairs": [{"bucket": f"pkg{i}",
+                   "pairs": [{"key": f"CVE-2022-{10000 + i}",
+                              "value": {"FixedVersion":
+                                        f"1.{i % 90}.5-r0"}}]}
+                  for i in range(8)]}]))
+    args = ["image", "--format", "json", "--backend", "cpu-ref",
+            "--sched", "off", "--no-cache",
+            "--db-fixtures", str(fx),
+            "--security-checks", "vuln"] + paths
+    assert main(args + ["--memo-cache", "memory"]) == 0
+    memo_out = capsys.readouterr().out
+    assert main(args + ["--no-memo"]) == 0
+    plain_out = capsys.readouterr().out
+    assert json.loads(memo_out) == json.loads(plain_out)

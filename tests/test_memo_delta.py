@@ -1,0 +1,302 @@
+"""DB-delta re-match property suite (``pytest -m memo``,
+docs/performance.md "Findings memoization & incremental re-scan").
+
+The contract under test: for seeded random generation pairs, a hot
+swap plus delta re-match over memoized fleets produces findings
+byte-identical to a full cold re-scan at the new generation — on both
+sched modes and at 1/2/4/8 mesh devices — while re-matching only the
+packages the delta touched.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from trivy_tpu.db import AdvisoryStore, CompiledDB
+from trivy_tpu.db.compiled import SwappableStore
+from trivy_tpu.db.delta import advisory_delta
+from trivy_tpu.db.lifecycle import attach_memo
+from trivy_tpu.memo import FindingsMemo, MemoryMemoStore
+from trivy_tpu.memo.metrics import MEMO_METRICS
+from trivy_tpu.runtime import BatchScanRunner
+from trivy_tpu.utils.synth import write_image_tar
+
+pytestmark = pytest.mark.memo
+
+N_PKGS = 12
+
+
+def _norm(results):
+    out = []
+    for r in results:
+        if r.error:
+            out.append((r.name, "error", r.error))
+        else:
+            out.append((r.name, r.status,
+                        json.dumps(r.report.to_dict(),
+                                   sort_keys=True)))
+    return out
+
+
+def _random_store(rng) -> AdvisoryStore:
+    store = AdvisoryStore()
+    for i in range(N_PKGS):
+        for a in range(1 + int(rng.integers(0, 3))):
+            vid = f"CVE-2024-{1000 * i + a}"
+            store.put_advisory(
+                "alpine 3.16", f"pkg{i}", vid,
+                {"FixedVersion":
+                 f"1.{int(rng.integers(0, 9))}."
+                 f"{int(rng.integers(0, 9))}-r0"})
+            store.put_vulnerability(vid, {
+                "Severity": ("LOW", "MEDIUM", "HIGH")[
+                    int(rng.integers(0, 3))],
+                "Title": f"adv {vid}"})
+    return store
+
+
+def _mutate(rng, old: AdvisoryStore) -> tuple:
+    """(new store, touched pkg names): change some fixes, add a new
+    advisory, add advisories for a previously advisory-free pkg,
+    drop one pkg's advisories entirely."""
+    new = AdvisoryStore()
+    touched = set()
+    drop = f"pkg{int(rng.integers(0, N_PKGS))}"
+    touched.add(drop)
+    for bucket, pkgs in old.buckets.items():
+        for pkg, advs in pkgs.items():
+            if pkg == drop:
+                continue
+            for vid, val in advs.items():
+                val = dict(val)
+                if rng.random() < 0.3:
+                    val["FixedVersion"] = \
+                        f"2.{int(rng.integers(0, 9))}.9-r0"
+                    touched.add(pkg)
+                new.put_advisory(bucket, pkg, vid, val)
+    for vid, v in old.vulnerabilities.items():
+        new.put_vulnerability(vid, v)
+    fresh = f"pkg{N_PKGS + 1}"          # never installed — inert
+    new.put_advisory("alpine 3.16", fresh, "CVE-2024-90000",
+                     {"FixedVersion": "9.9.9-r0"})
+    touched.add(fresh)
+    add_to = f"pkg{int(rng.integers(0, N_PKGS))}"
+    new.put_advisory("alpine 3.16", add_to, "CVE-2024-91000",
+                     {"FixedVersion": "1.0.1-r0"})
+    new.put_vulnerability("CVE-2024-91000", {"Severity": "HIGH",
+                                             "Title": "added"})
+    touched.add(add_to)
+    return new, touched
+
+
+APK = """P:{name}
+V:{version}
+o:{name}
+L:MIT
+
+"""
+
+
+def _fleet(tmp_path, rng, n_images: int = 3) -> list:
+    """Small fleet with a SHARED apk layer (the memoized one) plus a
+    unique text layer per image."""
+    apk = "".join(APK.format(name=f"pkg{i}",
+                             version=f"1.{i % 7}.{i % 5}-r0")
+                  for i in range(N_PKGS))
+    shared = {"etc/alpine-release": b"3.16.2\n",
+              "lib/apk/db/installed": apk.encode()}
+    paths = []
+    for n in range(n_images):
+        p = str(tmp_path / f"img{n}.tar")
+        write_image_tar(p, [shared,
+                            {f"srv/a{n}.txt": b"x = %d\n" % n}],
+                        repo_tag=f"delta/img:{n}")
+        paths.append(p)
+    return paths
+
+
+@pytest.mark.parametrize("sched", ["off", "on"])
+@pytest.mark.parametrize("ndev", [1, 2, 4, 8])
+def test_delta_rematch_byte_identical(tmp_path, sched, ndev):
+    """Property: memoized fleet + hot swap + delta re-match ==
+    full cold re-scan at the new generation, byte for byte, and the
+    re-match dispatches a strict subset of a cold scan's jobs."""
+    from trivy_tpu.parallel.mesh import make_mesh
+    seed = 77 + 13 * ndev + (1 if sched == "on" else 0)
+    rng = np.random.default_rng(seed)
+    s1 = _random_store(rng)
+    s2, _touched = _mutate(rng, s1)
+    cdb1, cdb2 = CompiledDB.compile(s1), CompiledDB.compile(s2)
+    mesh = make_mesh(ndev) if ndev > 1 else None
+    paths = _fleet(tmp_path, rng)
+
+    memo = FindingsMemo(MemoryMemoStore(), backend="tpu")
+    memo.mesh = mesh
+    r1 = BatchScanRunner(store=cdb1, backend="tpu", mesh=mesh,
+                         memo=memo, sched=sched)
+    r1.scan_paths(paths)
+    r1.close()
+
+    sw = SwappableStore(cdb1)
+    attach_memo(sw, memo)
+    before = MEMO_METRICS.snapshot()
+    sw.swap(cdb2, stage=False)
+    after = MEMO_METRICS.snapshot()
+    rematch_jobs = after["rematch_jobs"] - before["rematch_jobs"]
+
+    r2 = BatchScanRunner(store=cdb2, backend="tpu", mesh=mesh,
+                         memo=memo, sched=sched)
+    warm = r2.scan_paths(paths)
+    r2.close()
+    post = MEMO_METRICS.snapshot()
+    # post-swap scan is memo-served: nothing re-dispatches
+    assert post["misses"] == after["misses"]
+    assert post["hits"] > after["hits"]
+
+    cold_runner = BatchScanRunner(store=cdb2, backend="tpu",
+                                  mesh=mesh, sched=sched)
+    cold = cold_runner.scan_paths(paths)
+    cold_runner.close()
+    assert _norm(cold) == _norm(warm)
+
+    # the re-match is incremental: strictly fewer device jobs than
+    # one image's worth of a cold scan per memoized layer
+    cold_jobs = sum(len(cdb2.candidate_rows("alpine 3.16",
+                                            f"pkg{i}"))
+                    for i in range(N_PKGS))
+    assert 0 < rematch_jobs < cold_jobs
+
+
+def test_delta_names_exactly_the_touched_keys():
+    rng = np.random.default_rng(5)
+    s1 = _random_store(rng)
+    s2, touched = _mutate(rng, s1)
+    cdb1, cdb2 = CompiledDB.compile(s1), CompiledDB.compile(s2)
+    delta = advisory_delta(cdb1, cdb2)
+    assert {p for _, p in delta.touched} == touched
+    st = delta.stats()
+    assert st["added"] >= 1          # fresh pkg joins as a new key
+    assert st["changed"] >= 1        # advisory added to a live pkg
+    assert st["removed"] >= 1        # dropped pkg
+    # identical generations: empty delta
+    empty = advisory_delta(cdb1, CompiledDB.compile(s1))
+    assert not empty.touched
+
+
+def test_swap_to_identical_generation_migrates_everything(tmp_path):
+    """A re-compile with no content change re-keys every entry and
+    re-matches nothing."""
+    rng = np.random.default_rng(9)
+    s1 = _random_store(rng)
+    cdb1, cdb1b = CompiledDB.compile(s1), CompiledDB.compile(s1)
+    paths = _fleet(tmp_path, rng)
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    BatchScanRunner(store=cdb1, backend="cpu-ref",
+                    memo=memo).scan_paths(paths)
+    before = MEMO_METRICS.snapshot()
+    sw = SwappableStore(cdb1)
+    attach_memo(sw, memo)
+    sw.swap(cdb1b, stage=False)
+    after = MEMO_METRICS.snapshot()
+    assert after["rematch_jobs"] == before["rematch_jobs"]
+    # same content → same fingerprint → same ctx: entries untouched
+    warm = BatchScanRunner(store=cdb1b, backend="cpu-ref",
+                           memo=memo).scan_paths(paths)
+    post = MEMO_METRICS.snapshot()
+    assert post["misses"] == after["misses"]
+    assert all(r.status == "ok" for r in warm)
+
+
+def test_hot_swap_journal_fallback(tmp_path):
+    """Backends without enumeration (redis/s3) migrate via the
+    in-process key journal."""
+    class NoKeys(MemoryMemoStore):
+        def keys(self):
+            return None
+
+    rng = np.random.default_rng(21)
+    s1 = _random_store(rng)
+    s2, _ = _mutate(rng, s1)
+    cdb1, cdb2 = CompiledDB.compile(s1), CompiledDB.compile(s2)
+    paths = _fleet(tmp_path, rng)
+    memo = FindingsMemo(NoKeys(), backend="cpu-ref")
+    BatchScanRunner(store=cdb1, backend="cpu-ref",
+                    memo=memo).scan_paths(paths)
+    out = memo.hot_swap(cdb1, cdb2)
+    assert out["rematch_entries"] + out["migrated"] > 0
+    warm = BatchScanRunner(store=cdb2, backend="cpu-ref",
+                           memo=memo).scan_paths(paths)
+    cold = BatchScanRunner(store=cdb2,
+                           backend="cpu-ref").scan_paths(paths)
+    assert _norm(cold) == _norm(warm)
+
+
+def test_plain_store_swap_degrades_gracefully(tmp_path):
+    """Hot swap between non-compiled stores has no generation
+    handles: the memo just lets old entries age out (no delta, no
+    error), and scans against the new store recompute."""
+    rng = np.random.default_rng(33)
+    s1 = _random_store(rng)
+    s2, _ = _mutate(rng, s1)
+    paths = _fleet(tmp_path, rng)
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    BatchScanRunner(store=s1, backend="cpu-ref",
+                    memo=memo).scan_paths(paths)
+    out = memo.hot_swap(s1, s2)
+    assert out["rematch_jobs"] == 0
+    warm = BatchScanRunner(store=s2, backend="cpu-ref",
+                           memo=memo).scan_paths(paths)
+    cold = BatchScanRunner(store=s2,
+                           backend="cpu-ref").scan_paths(paths)
+    assert _norm(cold) == _norm(warm)
+
+
+def test_sbom_lib_delta_rematch(tmp_path):
+    """Library-ecosystem (prefix-join) records re-match too: an npm
+    advisory delta over memoized SBOM scans stays byte-identical."""
+    def mk(fix_lodash: str) -> AdvisoryStore:
+        st = AdvisoryStore()
+        st.put_advisory("npm::Node.js", "lodash", "CVE-2021-1",
+                        {"VulnerableVersions": [f"<{fix_lodash}"],
+                         "PatchedVersions": [f">={fix_lodash}"]})
+        st.put_advisory("npm::Node.js", "left-pad", "CVE-2021-2",
+                        {"VulnerableVersions": ["<2.0.0"],
+                         "PatchedVersions": [">=2.0.0"]})
+        for vid in ("CVE-2021-1", "CVE-2021-2"):
+            st.put_vulnerability(vid, {"Severity": "HIGH"})
+        return st
+
+    cdb1 = CompiledDB.compile(mk("4.17.21"))
+    cdb2 = CompiledDB.compile(mk("4.17.10"))   # lodash fix changed
+    doc = json.dumps({
+        "bomFormat": "CycloneDX", "specVersion": "1.4",
+        "version": 1,
+        "components": [
+            {"bom-ref": "a", "type": "library", "name": "lodash",
+             "version": "4.17.20",
+             "purl": "pkg:npm/lodash@4.17.20"},
+            {"bom-ref": "b", "type": "library", "name": "left-pad",
+             "version": "1.3.0",
+             "purl": "pkg:npm/left-pad@1.3.0"}],
+    }).encode()
+    boms = [("app.cdx.json", doc)]
+    memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+    r1 = BatchScanRunner(store=cdb1, backend="cpu-ref", memo=memo)
+    gen1 = r1.scan_boms(boms)
+    # gen1: 4.17.20 < 4.17.21 → vulnerable
+    assert "CVE-2021-1" in _norm(gen1)[0][2]
+    before = MEMO_METRICS.snapshot()
+    out = memo.hot_swap(cdb1, cdb2)
+    assert out["rematch_jobs"] >= 1
+    r2 = BatchScanRunner(store=cdb2, backend="cpu-ref", memo=memo)
+    warm = r2.scan_boms(boms)
+    post = MEMO_METRICS.snapshot()
+    assert post["misses"] == before["misses"]   # fully memo-served
+    cold = BatchScanRunner(store=cdb2,
+                           backend="cpu-ref").scan_boms(boms)
+    assert _norm(cold) == _norm(warm)
+    # gen2: 4.17.20 >= 4.17.10 → no longer vulnerable
+    assert "CVE-2021-1" not in _norm(warm)[0][2]

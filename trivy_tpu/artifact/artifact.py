@@ -49,6 +49,11 @@ class ArtifactOption:
     # per scan (never shared across targets).
     ingest_guards: bool = True
     ingest_limits: object = None       # ResourceLimits or None
+    # secret rule-set fingerprint (secret.batch.rules_fingerprint):
+    # cached blob CONTENT includes secret findings, so two rule
+    # configurations must never share blob cache keys. Empty =
+    # derive from ``secret_scanner`` (builtin when None).
+    secret_rules_fp: str = ""
 
 
 def _secret_scanner(opt: ArtifactOption):
@@ -113,6 +118,13 @@ class ImageArtifact:
                     # layers produce identical content either way)
                     "ingest_guards": self.budget is not None,
                     "secrets": self.opt.scan_secrets,
+                    # the rule set decides which secret findings a
+                    # blob carries — a trivy-secret.yaml custom set
+                    # must never share cached blobs with the builtin
+                    # corpus (and the findings memo keys on the same
+                    # fingerprint, docs/performance.md)
+                    "secret_rules": self._rules_fp()
+                    if self.opt.scan_secrets else "",
                     "misconfig": self.opt.scan_misconfig,
                     "licenses": self.opt.scan_licenses,
                     # the rekor URL changes analyzer/handler output
@@ -193,6 +205,15 @@ class ImageArtifact:
                 image_config=img.config,
             ),
         )
+
+    def _rules_fp(self) -> str:
+        """Secret rule-set fingerprint for the blob cache key: an
+        explicit fingerprint wins (the batch runner stamps its
+        shared sieve's), else the option's scanner, else builtin."""
+        if self.opt.secret_rules_fp:
+            return self.opt.secret_rules_fp
+        from ..secret.batch import rules_fingerprint
+        return rules_fingerprint(self.opt.secret_scanner)
 
     # --- analysis ---
 

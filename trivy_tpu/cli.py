@@ -127,6 +127,17 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--cache-backend", default="fs",
                         help="layer cache backend: fs | "
                         "redis://host:port")
+        sp.add_argument("--no-memo", action="store_true",
+                        help="disable the findings memo "
+                        "(docs/performance.md 'Findings "
+                        "memoization'): every layer's detection "
+                        "re-dispatches even when the same question "
+                        "was answered before")
+        sp.add_argument("--memo-cache", default="",
+                        help="findings-memo backend override: "
+                        "'memory', a directory, redis://host:port "
+                        "or s3://bucket/prefix — default rides the "
+                        "blob-cache tier (--cache-backend)")
         sp.add_argument("--timeout", default="5m0s",
                         help="scan timeout (e.g. 5m0s)")
         sp.add_argument("--profile-dir", default="",
@@ -333,6 +344,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="compiled advisory DB path prefix; the "
                      "server hot-swaps when the file changes")
     srv.add_argument("--db-watch-interval", type=float, default=60.0)
+    srv.add_argument("--no-memo", action="store_true",
+                     help="disable the findings memo "
+                     "(docs/performance.md)")
+    srv.add_argument("--memo-cache", default="",
+                     help="findings-memo backend override "
+                     "('memory', a directory, redis:// or s3://); "
+                     "default persists under --cache-dir")
     srv.add_argument("--sched", default="on",
                      choices=["on", "off"],
                      help="coalesce concurrent Scan RPCs through "
@@ -803,12 +821,14 @@ def run_server(args) -> int:
         except ValueError as e:
             print(f"error: --slo-config: {e}", file=sys.stderr)
             return 2
+    injector = _fault_injector(args)
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
                         token_header=args.token_header,
-                        sched=sched, slos=slos)
-    server.fault_injector = _fault_injector(args)
+                        sched=sched, slos=slos,
+                        memo=_memo(args, injector=injector))
+    server.fault_injector = injector
     print(f"trivy-tpu server listening on {args.listen}")
     serve_forever(host or "127.0.0.1", int(port), server,
                   db_watch_prefix=args.compiled_db,
@@ -1135,7 +1155,27 @@ def _rpc_error():
     return RPCError
 
 
-def _scanner(args, cache):
+def _memo(args, cache=None, option=None, injector=None):
+    """--memo wiring: a FindingsMemo over the blob-cache tier
+    (docs/performance.md "Findings memoization"), or None under
+    --no-memo / vuln-free scans. The memo backend mirrors
+    --cache-backend unless --memo-cache overrides it."""
+    if getattr(args, "no_memo", False):
+        return None
+    checks = [c for c in getattr(args, "security_checks",
+                                 "vuln").split(",") if c]
+    if "vuln" not in checks:
+        return None
+    from .memo import make_findings_memo
+    backend = getattr(args, "backend", "tpu")
+    return make_findings_memo(
+        cache=cache, cache_dir=getattr(args, "cache_dir", ""),
+        uri=getattr(args, "memo_cache", ""),
+        artifact_option=option, fault_injector=injector,
+        backend="cpu-ref" if backend == "cpu-ref" else "tpu")
+
+
+def _scanner(args, cache, option=None):
     """Local or remote scan driver — the client needs no DB when a
     server is set (ref run.go:269-271 initDB skipped), and a scan
     without vuln checks (e.g. the config command) skips advisory
@@ -1149,7 +1189,8 @@ def _scanner(args, cache):
                                  "vuln").split(",") if c]
     if "vuln" not in checks:
         return LocalScanner(cache, AdvisoryStore())
-    return LocalScanner(cache, _store(args))
+    return LocalScanner(cache, _store(args),
+                        memo=_memo(args, cache, option=option))
 
 
 def run_image(args) -> int:
@@ -1197,7 +1238,7 @@ def run_image(args) -> int:
                              budget=budget)
     try:
         ref = artifact.inspect()
-        scanner = _scanner(args, cache)
+        scanner = _scanner(args, cache, option=opt)
         results, os_found = scanner.scan(
             ScanTarget(name=ref.name, artifact_id=ref.id,
                        blob_ids=ref.blob_ids),
@@ -1324,7 +1365,9 @@ def _run_image_batch(args, targets: list) -> int:
         sched=("on" if args.sched == "on" else "off"),
         sched_config=sched_config,
         artifact_option=opt,
-        fault_injector=injector)
+        fault_injector=injector,
+        memo=_memo(args, cache, option=opt, injector=injector)
+        if "vuln" in checks else None)
     options = _scan_options(args)
     if injector is not None and injector.spec.deadline_s > 0:
         # deadline-storm scenario: the spec carries the per-request

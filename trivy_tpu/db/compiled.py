@@ -571,6 +571,28 @@ class CompiledDB(ResidentTables):
         from ..detect.metrics import DETECT_METRICS
         DETECT_METRICS.inc("db_invalidations")
 
+    # ---- content identity (trivy_tpu.memo) ----
+
+    def content_fingerprint(self) -> str:
+        """Content hash of the compiled tables + advisory records —
+        the cross-process "DB generation" the findings memo keys on
+        (``generation`` is process-monotonic and says nothing about
+        content). Cached: a CompiledDB is read-only after
+        compile/load."""
+        fp = getattr(self, "_content_fp", None)
+        if fp is None:
+            import hashlib
+            h = hashlib.sha256()
+            for a in (self.v_lo, self.v_hi, self.s_lo, self.s_hi,
+                      self.flags):
+                if a is not None:
+                    h.update(np.ascontiguousarray(a).tobytes())
+            h.update(json.dumps(
+                [[b, p, _adv_enc(a)] for b, p, a in self.rows_meta],
+                sort_keys=True, default=_json_default).encode())
+            fp = self._content_fp = h.hexdigest()[:32]
+        return fp
+
     # ---- enrichment reads (db.Config parity) ----
 
     def get_vulnerability(self, vuln_id: str):
@@ -735,6 +757,23 @@ class SwappableStore:
         self._lock = threading.Lock()
         self._readers = 0
         self._no_readers = threading.Condition(self._lock)
+        # swap hooks (db/lifecycle.attach_memo): called AFTER a new
+        # generation installs, with (old, new) — the findings memo
+        # registers its delta re-match here
+        self._swap_hooks: list = []
+
+    def add_swap_hook(self, fn) -> "SwappableStore":
+        """Register ``fn(old_db, new_db)`` to run after every swap.
+        Hook failures are logged, never raised — a broken observer
+        must not wedge the DB update."""
+        self._swap_hooks.append(fn)
+        return self
+
+    def remove_swap_hook(self, fn) -> None:
+        try:
+            self._swap_hooks.remove(fn)
+        except ValueError:
+            pass
 
     def acquire(self) -> CompiledDB:
         with self._lock:
@@ -770,3 +809,9 @@ class SwappableStore:
         drop = getattr(old, "invalidate_device", None)
         if drop is not None and old is not new_db:
             drop()
+        if old is not new_db:
+            for fn in list(self._swap_hooks):
+                try:
+                    fn(old, new_db)
+                except Exception as e:      # noqa: BLE001
+                    log.warning("swap hook %r failed: %r", fn, e)

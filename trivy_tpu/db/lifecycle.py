@@ -159,6 +159,27 @@ def needs_update(cache_dir: str, skip: bool = False,
     return True
 
 
+# --------------------------------------------------- hot-swap observers
+
+def attach_memo(store, memo):
+    """Register a findings memo (trivy_tpu.memo.FindingsMemo) on a
+    SwappableStore's swap lifecycle: every ``db update`` hot swap
+    computes the advisory delta between the outgoing and incoming
+    generations and re-matches only the delta-touched packages
+    against the new device-resident tables
+    (docs/performance.md "Findings memoization & incremental
+    re-scan"). Returns a detach callable."""
+    def hook(old_db, new_db):
+        memo.hot_swap(old_db, new_db)
+
+    store.add_swap_hook(hook)
+
+    def detach():
+        store.remove_swap_hook(hook)
+
+    return detach
+
+
 # ------------------------------------------------------------ OCI layout
 
 def _read_json(path: str) -> dict:

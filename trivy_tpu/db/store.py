@@ -99,6 +99,10 @@ class AdvisoryStore:
         self._adv_cache: dict = {}      # (bucket, pkg) → [Advisory]
         self._detail_cache: dict = {}   # vuln id → detail
         self._cpe_names = None          # index → [repo/nvr names]
+        # mutation epoch: the findings memo (trivy_tpu.memo) caches
+        # this store's content fingerprint against it, so fixture
+        # stores mutated after a scan re-fingerprint correctly
+        self.mutations = 0
 
     # --- writes ---
 
@@ -107,6 +111,7 @@ class AdvisoryStore:
         self.buckets.setdefault(bucket, {}) \
             .setdefault(pkg, {})[vuln_id] = value
         self._adv_cache.pop((bucket, pkg), None)
+        self.mutations += 1
         if bucket == "Red Hat CPE":
             # the CPE mapping feeds every expanded Red Hat advisory
             self._cpe_names = None
@@ -115,9 +120,11 @@ class AdvisoryStore:
     def put_vulnerability(self, vuln_id: str, value: dict) -> None:
         self.vulnerabilities[vuln_id] = value
         self._detail_cache.pop(vuln_id, None)
+        self.mutations += 1
 
     def put_data_source(self, bucket: str, value: dict) -> None:
         self.data_sources[bucket] = value
+        self.mutations += 1
         self._adv_cache = {k: v for k, v in self._adv_cache.items()
                            if k[0] != bucket}
 

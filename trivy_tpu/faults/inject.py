@@ -66,7 +66,8 @@ class FaultInjector:
                          "device_dispatches": 0, "device_faults": 0,
                          "image_loads": 0, "corrupt_faults": 0,
                          "stalls": 0, "rpc_posts": 0,
-                         "rpc_errors": 0, "rpc_drops": 0}
+                         "rpc_errors": 0, "rpc_drops": 0,
+                         "memo_loads": 0, "memo_corruptions": 0}
 
     def _inc(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -120,6 +121,32 @@ class FaultInjector:
             add_event("fault_injected", site="cache", op=op)
             raise CacheFault(
                 f"injected cache outage ({op} {key!r}, op #{n})")
+
+    # --- findings-memo site ---
+
+    def on_memo_load(self, key: str, raw: bytes) -> bytes:
+        """memo-poison scenario: damage the first N memo entry
+        reads (truncate + flip a byte) so the checksum layer in
+        trivy_tpu.memo must detect, drop, and recompute. Returns
+        the (possibly corrupted) raw bytes."""
+        spec = self.spec
+        if not spec.wants_memo_faults():
+            return raw
+        n = self._inc("memo_loads")
+        if spec.memo_corrupt_loads != -1 and \
+                n > spec.memo_corrupt_loads:
+            return raw
+        self._inc("memo_corruptions")
+        add_event("fault_injected", site="memo",
+                  kind="corrupt-entry")
+        if len(raw) < 8:
+            return b"\x00garbage"
+        # truncate mid-document and flip a byte — both a torn write
+        # and bit rot in one artifact
+        cut = max(8, len(raw) * 2 // 3)
+        damaged = bytearray(raw[:cut])
+        damaged[cut // 2] ^= 0x5A
+        return bytes(damaged)
 
     # --- host site ---
 

@@ -62,6 +62,12 @@ class FaultSpec:
     #    guard layer must quarantine each one per-target
     hostile: tuple = ()
 
+    # -- findings memo (trivy_tpu/memo): corrupt the first N memo
+    #    entry loads (-1 = every load) — the checksum must catch the
+    #    damage, drop the entry, and recompute transparently
+    #    (scan completes ok, byte-identical to cold)
+    memo_corrupt_loads: int = 0
+
     # -- tenant flood (docs/serving.md "Multi-tenant QoS"): like
     #    deadline-storm, the spec only carries the storm's shape —
     #    the harness (bench.py adversarial-tenant arm, tests) runs
@@ -86,6 +92,9 @@ class FaultSpec:
     def wants_tenant_flood(self) -> bool:
         return bool(self.flood_tenant and self.flood_rate > 0)
 
+    def wants_memo_faults(self) -> bool:
+        return bool(self.memo_corrupt_loads)
+
 
 # Named presets. ``standard-outage`` is the bench/acceptance scenario:
 # a cache outage long enough to trip the breaker and recover, one
@@ -107,6 +116,7 @@ SCENARIOS: dict = {
                         "device_fail_batches": 1,
                         "poison": ("poison",)},
     "hostile-ingest": {"hostile": ("all",)},
+    "memo-poison": {"memo_corrupt_loads": 4},
     "tenant-flood": {"flood_tenant": "flooder", "flood_rate": 400.0,
                      "flood_n": 256},
 }

@@ -252,6 +252,33 @@ def render_prometheus(stats: dict, phase_hists=None,
                  "DFA-table dispatches served per HBM upload.",
                  secret.get("dfa_upload_amortization"))
 
+    memo = stats.get("memo") or {}
+    if memo:
+        # findings-memo counters (docs/performance.md "Findings
+        # memoization & incremental re-scan")
+        for k, help_ in (
+                ("hits", "Memo queries served without dispatch."),
+                ("misses", "Memo queries that dispatched."),
+                ("stores", "Memo entries written."),
+                ("invalidations",
+                 "Memo sub-entries invalidated (delta-touched at "
+                 "hot swap, corrupt entries dropped)."),
+                ("bytes", "Memo entry bytes written.")):
+            w.scalar(f"{_PREFIX}_memo_{k}_total", "counter",
+                     help_, memo.get(k))
+        w.scalar(f"{_PREFIX}_memo_hit_rate", "gauge",
+                 "Memo query hit rate (hits / lookups).",
+                 memo.get("hit_rate"))
+        name = f"{_PREFIX}_memo_events_total"
+        w.header(name, "counter",
+                 "Findings-memo bookkeeping (layer hits, corrupt "
+                 "drops, degraded backend ops, delta re-match).")
+        for k in ("layer_hits", "corrupt", "lookup_errors",
+                  "store_errors", "migrated_entries",
+                  "rematch_jobs", "rematch_entries", "swaps"):
+            if k in memo:
+                w.sample(name, [("event", k)], memo[k])
+
     tenants = stats.get("tenants") or {}
     if tenants:
         # per-tenant fairness/QoS books (docs/serving.md

@@ -17,9 +17,11 @@ cause               the device was idle because ...
                        ``dfa_upload`` spans) — uploads serialize with
                        compute instead of double-buffering
 ``host_pack_bound``    the host was producing the next batch
-                       (``pack`` / ``analyze`` / ``join`` spans)
+                       (``pack`` / ``analyze`` / ``join`` /
+                       ``memo_lookup`` / ``delta_rematch`` spans)
 ``collect_bound``      the host was consuming the previous batch
-                       (``decode`` / ``report`` / ``finish`` spans)
+                       (``decode`` / ``report`` / ``finish`` /
+                       ``memo_store`` spans)
 ``dispatch_gap``       work was admitted — an open dispatch window
                        (``device`` span) or queued work
                        (``queue_wait`` / ``coalesce``) — but no
@@ -61,9 +63,14 @@ DEVICE_BUSY = frozenset({"device_compute", "dfa_scan"})
 CAUSE_SPANS = (
     ("upload_serialized", frozenset({"h2d_upload", "db_upload",
                                      "dfa_upload"})),
-    ("host_pack_bound", frozenset({"pack", "analyze", "join"})),
+    # memo_lookup (hit/miss partition) and delta_rematch (hot-swap
+    # migration) are host work that gates the next dispatch;
+    # memo_store is finish-side bookkeeping (trivy_tpu.memo)
+    ("host_pack_bound", frozenset({"pack", "analyze", "join",
+                                   "memo_lookup",
+                                   "delta_rematch"})),
     ("collect_bound", frozenset({"decode", "verify", "report",
-                                 "finish"})),
+                                 "finish", "memo_store"})),
     ("dispatch_gap", frozenset({"device", "queue_wait",
                                 "coalesce"})),
 )

@@ -195,7 +195,7 @@ class ScanServer:
                  sched: str = "off", sched_config=None,
                  max_body_bytes: int = MAX_BODY_BYTES,
                  max_scan_blobs: int = MAX_SCAN_BLOBS,
-                 tracer=None, slos=None):
+                 tracer=None, slos=None, memo=None):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -206,6 +206,14 @@ class ScanServer:
         if cache is None:
             cache = FSCache(cache_dir) if cache_dir else MemoryCache()
         self.cache = cache
+        # memo: trivy_tpu.memo.FindingsMemo (or None) — per-layer
+        # detection-verdict memoization for every scan path, with
+        # the advisory-delta re-match registered on the store's hot
+        # swap (docs/performance.md "Findings memoization")
+        self.memo = memo
+        if memo is not None:
+            from ..db.lifecycle import attach_memo
+            attach_memo(self.store, memo)
         self.token = token
         self.token_header = token_header
         self._idem = _IdempotencyCache()
@@ -371,7 +379,8 @@ class ScanServer:
         tenant = _clean_tenant(body.get("tenant"))
         try:
             with root.activate():
-                scanner = LocalScanner(self.cache, db)
+                scanner = LocalScanner(self.cache, db,
+                                       memo=self.memo)
                 results, os_found = scanner.scan(target, options)
         except BaseException:
             root.end("failed")
@@ -400,7 +409,8 @@ class ScanServer:
         db = self.store.acquire()
 
         def analyze(req):
-            scanner = LocalScanner(self.cache, db)
+            scanner = LocalScanner(self.cache, db,
+                                       memo=self.memo)
             prepared = scanner.prepare(target, options)
 
             def finish(found, detected):
@@ -467,6 +477,14 @@ class ScanServer:
             # when serving is on; sched-off servers report them too
             from ..db.compiled import resident_snapshot
             out["resident"] = resident_snapshot()
+        if "memo" not in out:
+            # findings-memo counters (hits/misses/stores/
+            # invalidations, delta re-match) — sched-off servers
+            # report them too
+            from ..memo.metrics import MEMO_METRICS
+            out["memo"] = MEMO_METRICS.snapshot()
+        if self.memo is not None:
+            out["memo"] = self.memo.stats()
         if "slo" not in out:
             out["slo"] = self.slo.snapshot()
         out["profiler"] = self.profiler.stats()

@@ -76,10 +76,15 @@ class BatchScanRunner:
                  cache=None, backend: str = "tpu", mesh=None,
                  secret_scanner=None, sched="off",
                  sched_config=None, artifact_option=None,
-                 fault_injector=None, tracer=None):
+                 fault_injector=None, tracer=None, memo=None):
         from ..obs.trace import get_tracer
         self.store = store or AdvisoryStore()
         self.cache = cache if cache is not None else MemoryCache()
+        # memo: trivy_tpu.memo.FindingsMemo (or None) — per-layer
+        # detection-verdict memoization threaded into every
+        # LocalScanner this runner constructs, on both execution
+        # paths (docs/performance.md "Findings memoization")
+        self.memo = memo
         self.backend = backend
         self.mesh = mesh
         # tracer: trivy_tpu.obs.Tracer — per-request span trees on
@@ -185,13 +190,23 @@ class BatchScanRunner:
         """Per-scan artifact option: the runner-level template (CLI
         skip dirs / file patterns) with secret scanning routed to the
         batch sieve instead of a per-artifact scanner."""
+        from ..secret.batch import rules_fingerprint
         if self.artifact_option is None:
-            return ArtifactOption(scan_secrets=scan_secrets)
+            return ArtifactOption(
+                scan_secrets=scan_secrets,
+                secret_rules_fp=rules_fingerprint(
+                    self.secret_scanner))
         import copy
         opt = copy.copy(self.artifact_option)
         opt.scan_secrets = scan_secrets and \
             self.artifact_option.scan_secrets
         opt.secret_scanner = None
+        # blob keys must reflect the sieve that ACTUALLY produces
+        # this runner's secret findings (the shared batch scanner,
+        # not the per-option default)
+        if not opt.secret_rules_fp:
+            opt.secret_rules_fp = rules_fingerprint(
+                self.secret_scanner)
         return opt
 
     # --- the scheduled (continuous-batching) route ---
@@ -293,7 +308,8 @@ class BatchScanRunner:
                 # with ingest-stage causes
                 for kind, msg in a.budget.soft_faults:
                     req.record_fault("ingest", kind, msg)
-            scanner = LocalScanner(self.cache, self.store)
+            scanner = LocalScanner(self.cache, self.store,
+                                   memo=self.memo)
             prepared = scanner.prepare(
                 ScanTarget(name=ref.name, artifact_id=ref.id,
                            blob_ids=ref.blob_ids), options)
@@ -423,7 +439,8 @@ class BatchScanRunner:
         # ---- phase 3: squash + advisory join (host) ----
         from ..obs.trace import phase_span
         t0 = _time.perf_counter()
-        scanner = LocalScanner(self.cache, self.store)
+        scanner = LocalScanner(self.cache, self.store,
+                                   memo=self.memo)
         prepared = []
         # the join span makes this host phase visible to the idle-
         # attribution timeline (host_pack_bound — the device waits
@@ -593,7 +610,8 @@ class BatchScanRunner:
             # fleet (ValueError resolves this request only)
             atype, decoded, blob, blob_id = decode_to_blob(data)
             self.cache.put_blob(blob_id, blob)
-            scanner = LocalScanner(self.cache, self.store)
+            scanner = LocalScanner(self.cache, self.store,
+                                   memo=self.memo)
             prepared = scanner.prepare(
                 ScanTarget(name=name, artifact_id=blob_id,
                            blob_ids=[blob_id]), options)
@@ -635,7 +653,8 @@ class BatchScanRunner:
         # fails only its own slot.
         from .hostpool import map_in_pool
         t0 = _time.perf_counter()
-        scanner = LocalScanner(self.cache, self.store)
+        scanner = LocalScanner(self.cache, self.store,
+                                   memo=self.memo)
 
         def decode_one(item):
             name, data = item

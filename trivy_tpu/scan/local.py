@@ -52,14 +52,24 @@ class PreparedScan:
     jobs: list
     eosl: bool
     pkg_results: list
+    # findings-memo state (trivy_tpu.memo): the per-package queries
+    # _vuln_jobs recorded, and the hit/miss partition plan finish()
+    # resolves — both None when no memo is attached
+    queries: Optional[list] = None
+    memo_plan: object = None
 
 
 class LocalScanner:
-    def __init__(self, cache, store: Optional[AdvisoryStore] = None):
+    def __init__(self, cache, store: Optional[AdvisoryStore] = None,
+                 memo=None):
         self.cache = cache
         self.store = store or AdvisoryStore()
         self.compiled: Optional[CompiledDB] = \
             store if isinstance(store, CompiledDB) else None
+        # memo: trivy_tpu.memo.FindingsMemo — per-layer detection
+        # verdicts served without device dispatch when the exact
+        # question was answered before (docs/performance.md)
+        self.memo = memo
 
     def scan(self, target: ScanTarget, options: ScanOptions) -> tuple:
         """Returns (results, os) — single-target convenience around
@@ -107,11 +117,21 @@ class LocalScanner:
             pkg_results.extend(self._lang_pkgs_results(detail))
 
         jobs, eosl = ([], False)
+        queries = [] if self.memo is not None else None
         if "vuln" in options.security_checks:
-            jobs, eosl = self._vuln_jobs(detail, options)
-        return PreparedScan(target=target, options=options,
-                            detail=detail, jobs=jobs, eosl=eosl,
-                            pkg_results=pkg_results)
+            jobs, eosl = self._vuln_jobs(detail, options,
+                                         queries=queries)
+        prepared = PreparedScan(target=target, options=options,
+                                detail=detail, jobs=jobs, eosl=eosl,
+                                pkg_results=pkg_results,
+                                queries=queries)
+        if self.memo is not None and jobs:
+            # hit/miss partition: verdicts answered before are
+            # served at finish; only novel queries keep their jobs
+            # for the device dispatch (docs/performance.md)
+            prepared.memo_plan = self.memo.partition(
+                prepared, blobs, detail, options, db=self.store)
+        return prepared
 
     def finish(self, prepared: PreparedScan,
                detected: list) -> tuple:
@@ -119,6 +139,14 @@ class LocalScanner:
         options = prepared.options
         detail = prepared.detail
         results: list = []
+
+        if prepared.memo_plan is not None:
+            # record the novel queries' verdicts, append the served
+            # hits — the hit payloads are THIS scan's own job
+            # payloads, so results are byte-identical to a cold run
+            detected = self.memo.resolve(prepared.memo_plan,
+                                         detected)
+            prepared.memo_plan = None
 
         if "vuln" in options.security_checks:
             if detail.os is not None:
@@ -160,9 +188,12 @@ class LocalScanner:
 
     # --- vulnerabilities ---
 
-    def _vuln_jobs(self, detail, options) -> tuple:
+    def _vuln_jobs(self, detail, options,
+                   queries: Optional[list] = None) -> tuple:
         jobs: list = []
         eosl = False
+        if queries is not None:
+            from ..memo.findings import MemoQuery
 
         cdb = self.compiled
         if "os" in options.vuln_type and detail.os is not None \
@@ -174,6 +205,7 @@ class LocalScanner:
                                        detail.repository)
                 for pkg in detail.packages:
                     installed = driver.installed(pkg)
+                    qstart = len(jobs)
                     if cdb is not None:
                         for row in cdb.candidate_rows(
                                 bucket, driver.src_name(pkg)):
@@ -188,14 +220,24 @@ class LocalScanner:
                                 report_unfixed=driver.report_unfixed,
                                 payload=("os", None, self._ospkg_vuln(
                                     driver, pkg, installed, adv))))
-                        continue
-                    for adv in self.store.get(bucket,
-                                              driver.src_name(pkg)):
-                        if not driver.adv_match(detail.os.name,
-                                                pkg, adv):
-                            continue
-                        jobs.append(self._ospkg_job(
-                            driver, pkg, installed, adv))
+                    else:
+                        for adv in self.store.get(
+                                bucket, driver.src_name(pkg)):
+                            if not driver.adv_match(detail.os.name,
+                                                    pkg, adv):
+                                continue
+                            jobs.append(self._ospkg_job(
+                                driver, pkg, installed, adv))
+                    if queries is not None and len(jobs) > qstart:
+                        queries.append(MemoQuery(
+                            kind="os", bucket=bucket,
+                            name=driver.src_name(pkg),
+                            grammar=driver.grammar,
+                            installed=installed,
+                            report_unfixed=driver.report_unfixed,
+                            pkg=pkg, start=qstart, end=len(jobs),
+                            os_name=detail.os.name,
+                            family=detail.os.family))
             elif detail.os.family not in ("none", ""):
                 log.warning("unsupported os: %s", detail.os.family)
 
@@ -206,6 +248,7 @@ class LocalScanner:
                 eco, grammar = LIB_TYPES[app.type]
                 for lib in app.libraries:
                     name = normalize_pkg_name(eco, lib.name)
+                    qstart = len(jobs)
                     if cdb is not None:
                         for row in cdb.candidate_rows_prefix(
                                 f"{eco}::", name):
@@ -216,11 +259,18 @@ class LocalScanner:
                                 payload=("lib",
                                          (app.type, app.file_path),
                                          self._lib_vuln(lib, adv))))
-                        continue
-                    for adv in self.store.get_advisories(
-                            f"{eco}::", name):
-                        jobs.append(self._lib_job(
-                            app, grammar, lib, adv))
+                    else:
+                        for adv in self.store.get_advisories(
+                                f"{eco}::", name):
+                            jobs.append(self._lib_job(
+                                app, grammar, lib, adv))
+                    if queries is not None and len(jobs) > qstart:
+                        queries.append(MemoQuery(
+                            kind="lib", bucket=f"{eco}::",
+                            name=name, grammar=grammar,
+                            installed=lib.version,
+                            report_unfixed=True, pkg=lib,
+                            start=qstart, end=len(jobs)))
         return jobs, eosl
 
     def _vuln_results(self, target: str, detail,

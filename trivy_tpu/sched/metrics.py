@@ -279,4 +279,9 @@ class SchedMetrics:
         # (trivy_tpu_resident_bytes on /metrics)
         from ..db.compiled import resident_snapshot
         out["resident"] = resident_snapshot()
+        # findings-memo counters (docs/performance.md "Findings
+        # memoization"): hit/miss/store/invalidation totals plus the
+        # delta re-match accounting — process-wide like the rest
+        from ..memo.metrics import MEMO_METRICS
+        out["memo"] = MEMO_METRICS.snapshot()
         return out

@@ -49,6 +49,52 @@ log = get_logger("secret.batch")
 SEG_LEN = 2048       # segment length in bytes
 OVERLAP = 16         # floor; raised to the plan's min_overlap
 
+_BUILTIN_RULES_FP = [None]
+
+
+def rules_fingerprint(scanner=None) -> str:
+    """Content hash of a secret rule SET — a blob-cache and
+    findings-memo key component (docs/performance.md): two rule
+    configurations (builtin vs a trivy-secret.yaml custom set) must
+    never share cached secret findings. ``scanner`` is a
+    BatchSecretScanner, a bare Scanner, or None (the builtin
+    corpus, hashed once per process)."""
+    import hashlib
+    inner = getattr(scanner, "scanner", scanner)
+    rules = getattr(inner, "rules", None)
+    if rules is None:
+        if _BUILTIN_RULES_FP[0] is None:
+            from .scanner import new_scanner
+            _BUILTIN_RULES_FP[0] = rules_fingerprint(new_scanner())
+        return _BUILTIN_RULES_FP[0]
+    cached = getattr(inner, "_rules_fp", None)
+    if cached is not None:
+        return cached
+    h = hashlib.sha256()
+    for r in rules:
+        h.update(repr((
+            r.id, r.category, r.severity,
+            r.regex.pattern if r.regex is not None else "",
+            tuple(r.keywords),
+            r.path.pattern if r.path is not None else "",
+            tuple((a.id, a.regex.pattern if a.regex is not None
+                   else "", a.path.pattern if a.path is not None
+                   else "") for a in r.allow_rules),
+            r.secret_group_name)).encode())
+    # global allow rules / exclude blocks change findings too
+    for a in getattr(inner, "allow_rules", ()):
+        h.update(repr((a.id,
+                       a.regex.pattern if a.regex is not None
+                       else "",
+                       a.path.pattern if a.path is not None
+                       else "")).encode())
+    fp = h.hexdigest()[:16]
+    try:
+        inner._rules_fp = fp     # rule sets are static after build
+    except AttributeError:
+        pass
+    return fp
+
 
 @dataclass
 class _FileEntry:
