@@ -1375,6 +1375,226 @@ def _adversarial_tenant_arm(paths: list, store,
     }
 
 
+# --- continuous-scanning watch bench (ROADMAP item 5 gate) ---------
+
+N_WATCH = 48                    # fleet the push events draw from
+N_WATCH_EVENTS = 96             # events per sweep arm
+WATCH_RATE_MULTS = (0.5, 1.0, 2.0)   # arrival rate vs warm capacity
+ADMISSION_DEADLINE_S = 2.0      # warm-hit p99 gate
+N_ADMISSION = 24                # reviews per admission round
+
+
+def bench_watch() -> dict:
+    """Sustained-rate continuous-scanning bench (docs/serving.md
+    "Continuous scanning & admission control"): a seeded synthetic
+    push-event source drives the watch loop at a sweep of arrival
+    rates against a WARM findings-memo store, recording the
+    p99-vs-arrival-rate SLO curve; a K8s admission arm gates the
+    warm-hit review p99 under the deadline; the event-storm arm
+    gates that debounce collapses duplicate-tag bursts, malformed
+    notifications are counted and dropped, overload sheds through
+    the existing 429/503 paths, and the loop never crashes; and
+    watch-mode findings are gated byte-identical to a one-shot batch
+    scan of the same digest set."""
+    import os
+    import tempfile
+    import threading
+
+    from trivy_tpu.artifact.cache import MemoryCache
+    from trivy_tpu.faults import parse_fault_spec
+    from trivy_tpu.memo import make_findings_memo
+    from trivy_tpu.runtime import BatchScanRunner
+    from trivy_tpu.watch import (AdmissionController,
+                                 AdmissionPolicy, SyntheticSource,
+                                 WatchConfig, WatchLoop,
+                                 WebhookSource, make_event_storm)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = make_fleet(tmp, N_WATCH)
+        store = make_store()
+        cache = MemoryCache()
+        memo = make_findings_memo(backend="tpu")
+
+        # the byte-identity baseline: a one-shot direct batch scan
+        # of the same digest set (no scheduler, no memo)
+        baseline = BatchScanRunner(store=store,
+                                   backend="tpu").scan_paths(paths)
+        base_by_name = {r.name: _norm([r])[0] for r in baseline}
+
+        # warm the memo + blob cache, then measure warm capacity —
+        # the sweep offers rates relative to what a fully warm
+        # re-scan can actually sustain
+        warm = BatchScanRunner(store=store, cache=cache,
+                               backend="tpu", sched=_sched_cfg(),
+                               memo=memo)
+        warm.scan_paths(paths)
+        t0 = time.perf_counter()
+        warm.scan_paths(paths)
+        warm_ips = len(paths) / (time.perf_counter() - t0)
+        warm.close()
+
+        # --- arm 1: p99 vs arrival rate (the SLO curve) ---
+        curve = []
+        identical = checked = 0
+        for i, mult in enumerate(WATCH_RATE_MULTS):
+            rate = max(2.0, mult * warm_ips)
+            runner = BatchScanRunner(
+                store=store, cache=cache, backend="tpu",
+                sched=_sched_cfg(flush_timeout_s=0.05,
+                                 eager_idle_flush=False),
+                memo=memo)
+            src = SyntheticSource(paths, rate=rate,
+                                  n_events=N_WATCH_EVENTS,
+                                  seed=20260804 + i, dup_rate=0.3,
+                                  paced=True)
+            loop = WatchLoop(runner, src, WatchConfig(
+                debounce_s=0.05, max_inflight=64,
+                keep_results=(i == 0)))
+            t0 = time.perf_counter()
+            stats = loop.run()
+            wall = time.perf_counter() - t0
+            lat = runner.scheduler.stats()["latency"]["request"]
+            runner.close()
+            assert stats["failed"] == 0, \
+                f"watch arm x{mult}: {stats['failed']} scans failed"
+            assert stats["events"] == (stats["scans"]
+                                       + stats["deduped"]
+                                       + stats["shed"]), \
+                f"watch arm x{mult}: event books do not balance: " \
+                f"{stats}"
+            curve.append({
+                "rate_mult": mult,
+                "offered_rate_eps": round(rate, 2),
+                "events": stats["events"],
+                "scans": stats["scans"],
+                "deduped": stats["deduped"],
+                "shed": stats["shed"],
+                "sustained_eps": round(stats["events"] / wall, 2)
+                if wall else 0.0,
+                "p50_s": lat["p50_s"],
+                "p99_s": lat["p99_s"],
+            })
+            if i == 0:
+                # byte-identity gate: watch-mode reports == the
+                # one-shot batch scan of the same digests
+                for res in loop.results.values():
+                    assert _norm([res])[0] == \
+                        base_by_name[res.name], \
+                        f"watch report diverges for {res.name}"
+                    identical += 1
+                checked = identical
+                assert checked > 0, "watch arm retained no results"
+
+        # --- arm 2: admission webhook against the warm memo ---
+        by_ref = {os.path.basename(p): p for p in paths}
+
+        def resolver(ref, digest):
+            return by_ref.get(ref.split(":")[0])
+
+        def review(ref, uid):
+            return {"apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": uid, "object": {
+                        "kind": "Pod", "metadata": {"name": uid},
+                        "spec": {"containers": [
+                            {"name": "c", "image": ref}]}}}}
+
+        runner = BatchScanRunner(store=store, cache=cache,
+                                 backend="tpu",
+                                 sched=_sched_cfg(), memo=memo)
+        ctl = AdmissionController(
+            runner, store=store, memo=memo,
+            policy=AdmissionPolicy.parse("deny:HIGH,CRITICAL"),
+            resolver=resolver,
+            default_deadline_s=ADMISSION_DEADLINE_S)
+        warm_lat, cached_lat = [], []
+        denies = 0
+        for round_lat in (warm_lat, cached_lat):
+            for i in range(N_ADMISSION):
+                ref = os.path.basename(paths[i % len(paths)])
+                t0 = time.perf_counter()
+                out = ctl.review(review(ref, f"u{i}"))
+                round_lat.append(time.perf_counter() - t0)
+                if not out["response"]["allowed"]:
+                    denies += 1
+        runner.close()
+
+        def p99(xs):
+            return sorted(xs)[max(0, int(0.99 * len(xs)) - 1)]
+
+        warm_p99 = p99(warm_lat)
+        cached_p99 = p99(cached_lat)
+        # gate (a): a warm-memo admission verdict resolves within
+        # the deadline at p99 — the cache-hit-question claim
+        assert warm_p99 <= ADMISSION_DEADLINE_S, \
+            f"warm admission p99 {warm_p99:.3f}s over the " \
+            f"{ADMISSION_DEADLINE_S}s deadline"
+        assert denies > 0, \
+            "admission denied nothing on a vulnerable fleet"
+
+        # --- arm 3: event storm (never crashes, sheds typed) ---
+        spec = parse_fault_spec("event-storm")
+        storm = make_event_storm(spec, paths)
+        # same ref->path contract as the admission arm: one resolver
+        src = WebhookSource(resolver=resolver)
+        runner = BatchScanRunner(
+            store=store, cache=cache, backend="tpu",
+            sched=_sched_cfg(max_queue=16), memo=memo)
+        loop = WatchLoop(runner, src, WatchConfig(
+            debounce_s=0.02, max_inflight=8, submit_retries=1,
+            backoff_max_s=0.05))
+        accepted = {"n": 0, "malformed": 0}
+
+        def push():
+            for body in storm:
+                out = src.push_notification(body)
+                accepted["n"] += out["accepted"]
+                accepted["malformed"] += out["malformed"]
+            src.close()
+
+        t = threading.Thread(target=push, daemon=True)
+        t.start()
+        stats = loop.run()
+        t.join(timeout=60)
+        runner.close()
+        # gate (b): zero loop crashes — every accepted event is
+        # accounted for, malformed envelopes were dropped at the
+        # boundary, duplicates collapsed
+        assert accepted["malformed"] == spec.storm_malformed
+        assert stats["events"] == accepted["n"] - src.dropped, \
+            f"storm lost events: {stats} vs {accepted}"
+        assert stats["events"] == (stats["scans"]
+                                   + stats["deduped"]
+                                   + stats["shed"]), \
+            f"storm books do not balance: {stats}"
+        assert stats["deduped"] > 0, "storm duplicates not folded"
+
+        return {
+            "images": len(paths),
+            "warm_capacity_ips": round(warm_ips, 2),
+            "slo_curve": curve,
+            "byte_identical_reports": checked,
+            "admission": {
+                "reviews": 2 * N_ADMISSION,
+                "deadline_s": ADMISSION_DEADLINE_S,
+                "warm_p99_s": round(warm_p99, 4),
+                "cached_p99_s": round(cached_p99, 4),
+                "warm_mean_s": round(
+                    sum(warm_lat) / len(warm_lat), 4),
+                "denies": denies,
+            },
+            "event_storm": {
+                "notifications": len(storm),
+                "events": stats["events"],
+                "scans": stats["scans"],
+                "deduped": stats["deduped"],
+                "shed": stats["shed"],
+                "malformed": accepted["malformed"],
+                "dropped": src.dropped,
+            },
+        }
+
+
 N_FAULT_IMAGES = 64
 
 
@@ -1778,7 +1998,8 @@ def _run_config(cfg: str) -> dict:
             "hostile": bench_hostile,
             "obs": bench_obs,
             "timeline": bench_timeline,
-            "fleet-warm": bench_fleet_warm}[cfg]()
+            "fleet-warm": bench_fleet_warm,
+            "watch": bench_watch}[cfg]()
 
 
 def _subprocess_config(cfg: str) -> dict:
@@ -1828,6 +2049,7 @@ def main() -> None:
     obs = _subprocess_config("obs")
     timeline = _subprocess_config("timeline")
     fleet_warm = _subprocess_config("fleet-warm")
+    watch = _subprocess_config("watch")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -1856,6 +2078,7 @@ def main() -> None:
         "obs": obs,
         "timeline": timeline,
         "fleet_warm": fleet_warm,
+        "watch": watch,
     }))
 
 

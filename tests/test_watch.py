@@ -1,0 +1,780 @@
+"""Continuous-scanning subsystem tests (``pytest -m watch``,
+docs/serving.md "Continuous scanning & admission control").
+
+Covers the watch loop (dedupe/debounce, checkpoint resume, in-flight
+watermarks, the storm-drain accounting invariant), the registry
+notification parse boundary, the K8s admission webhook over real
+HTTP (allow / deny / fail-open / fail-closed / 408 / malformed), the
+memo-``ctx_sig`` verdict invalidation on a db hot swap, and the
+watch/admission metrics surface on both sched modes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.db import AdvisoryStore, CompiledDB
+from trivy_tpu.db.compiled import SwappableStore
+from trivy_tpu.memo import make_findings_memo
+from trivy_tpu.runtime import BatchScanRunner
+from trivy_tpu.sched import SchedConfig
+from trivy_tpu.utils.synth import tiny_fleet
+from trivy_tpu.watch import (AdmissionController, AdmissionPolicy,
+                             Cursor, PushEvent, SyntheticSource,
+                             TraceSource, WATCH_METRICS, WatchConfig,
+                             WatchLoop, WebhookSource,
+                             make_event_storm, parse_notification)
+from trivy_tpu.watch.source import MANIFEST_MEDIA_TYPES
+
+pytestmark = pytest.mark.watch
+
+
+def _sched_cfg(**kw):
+    base = dict(workers=2, flush_timeout_s=0.02, max_queue=64)
+    base.update(kw)
+    return SchedConfig(**base)
+
+
+def _runner(store, memo=None, **sched_kw):
+    return BatchScanRunner(store=store, backend="cpu-ref",
+                           sched=_sched_cfg(**sched_kw), memo=memo)
+
+
+def _events(paths, n, digests=None):
+    """n events round-robined over `digests` distinct images."""
+    digests = digests or len(paths)
+    out = []
+    for i in range(n):
+        p = paths[i % digests]
+        out.append(PushEvent(digest=f"sha256:{i % digests:04x}",
+                             ref=f"img{i % digests}", path=p,
+                             seq=i))
+    return out
+
+
+def _norm(result) -> str:
+    return json.dumps(result.report.to_dict(), sort_keys=True)
+
+
+def _books_balance(stats) -> bool:
+    return stats["events"] == (stats["scans"] + stats["deduped"]
+                               + stats["shed"])
+
+
+# ------------------------------------------------------------------
+# notification parse boundary
+# ------------------------------------------------------------------
+
+class TestNotificationParse:
+    def test_push_manifest_becomes_event(self):
+        body = {"events": [{"id": "e1", "action": "push",
+                            "target": {
+                                "mediaType": MANIFEST_MEDIA_TYPES[0],
+                                "repository": "acme/api",
+                                "tag": "v3",
+                                "digest": "sha256:abc"}}]}
+        events, malformed = parse_notification(body)
+        assert malformed == 0 and len(events) == 1
+        ev = events[0]
+        assert ev.ref == "acme/api:v3"
+        assert ev.digest == "sha256:abc"
+        assert ev.event_id == "e1"
+
+    def test_pulls_and_blob_pushes_are_ignored_not_malformed(self):
+        body = {"events": [
+            {"action": "pull", "target": {
+                "repository": "a", "digest": "sha256:1"}},
+            {"action": "push", "target": {
+                "mediaType": "application/octet-stream",
+                "repository": "a", "digest": "sha256:2"}},
+        ]}
+        events, malformed = parse_notification(body)
+        assert events == [] and malformed == 0
+
+    def test_malformed_counted_and_dropped(self):
+        body = {"events": [
+            {"action": "push", "target": {}},              # no repo
+            {"action": "push",
+             "target": {"repository": "a"}},               # no digest
+            "not-a-dict",
+            {"action": "push", "target": {
+                "mediaType": MANIFEST_MEDIA_TYPES[0],
+                "repository": "ok", "digest": "sha256:ok"}},
+        ]}
+        before = WATCH_METRICS.snapshot()["malformed"]
+        events, malformed = parse_notification(body)
+        assert len(events) == 1 and malformed == 3
+        assert WATCH_METRICS.snapshot()["malformed"] == before + 3
+
+    def test_non_envelope_is_one_malformed(self):
+        for body in (["x"], {"events": "nope"}, None, 42):
+            events, malformed = parse_notification(body)
+            assert events == [] and malformed == 1
+
+    def test_resolver_maps_refs(self, tmp_path):
+        from trivy_tpu.watch import dir_resolver
+        tar = tmp_path / "acme_api_v3.tar"
+        tar.write_bytes(b"x")
+        resolve = dir_resolver(str(tmp_path))
+        assert resolve("acme/api:v3") == str(tar)
+        assert resolve("unknown:ref") is None
+
+
+# ------------------------------------------------------------------
+# loop: dedupe / debounce / checkpoint / watermark
+# ------------------------------------------------------------------
+
+class TestWatchLoop:
+    @pytest.fixture(scope="class")
+    def fleet(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("watch-fleet")
+        return tiny_fleet(str(tmp), 4)
+
+    def test_burst_debounce_scans_once(self, fleet):
+        paths, store = fleet
+        # a tag repushed 5x in a burst: same digest, one scan
+        events = [PushEvent(digest="sha256:same", ref="img0",
+                            path=paths[0], seq=i) for i in range(5)]
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(events),
+                         WatchConfig(debounce_s=0.1))
+        stats = loop.run()
+        runner.close()
+        assert stats["scans"] == 1
+        assert stats["deduped"] == 4
+        assert stats["shed"] == 0
+        assert _books_balance(stats)
+
+    def test_distinct_digests_scan_separately(self, fleet):
+        paths, store = fleet
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(
+            _events(paths, 8, digests=4)),
+            WatchConfig(debounce_s=0.05))
+        stats = loop.run()
+        runner.close()
+        assert stats["scans"] == 4
+        assert stats["deduped"] == 4
+        assert _books_balance(stats)
+
+    def test_zero_debounce_folds_into_inflight(self, fleet):
+        paths, store = fleet
+        events = [PushEvent(digest="sha256:one", ref="img0",
+                            path=paths[0], seq=i) for i in range(3)]
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(events),
+                         WatchConfig(debounce_s=0.0))
+        stats = loop.run()
+        runner.close()
+        # the first submits immediately; followers either folded
+        # into the in-flight scan or (post-completion) scanned again
+        assert stats["scans"] >= 1
+        assert _books_balance(stats)
+
+    def test_unresolvable_event_sheds(self, fleet):
+        _, store = fleet
+        events = [PushEvent(digest="sha256:x", ref="ghost",
+                            path="", seq=0)]
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(events),
+                         WatchConfig(debounce_s=0.0))
+        stats = loop.run()
+        runner.close()
+        assert stats["shed"] == 1 and stats["unresolvable"] == 1
+        assert _books_balance(stats)
+
+    def test_watermark_bounds_inflight(self, fleet):
+        paths, store = fleet
+        events = []
+        for i in range(12):       # 12 DISTINCT digests
+            events.append(PushEvent(digest=f"sha256:wm{i}",
+                                    ref=f"img{i}",
+                                    path=paths[i % len(paths)],
+                                    seq=i))
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(events),
+                         WatchConfig(debounce_s=0.0,
+                                     max_inflight=2))
+        stats = loop.run()
+        runner.close()
+        assert stats["inflight_peak"] <= 2
+        assert stats["scans"] == 12
+        assert _books_balance(stats)
+
+    def test_source_errors_survive_with_backoff(self, fleet):
+        paths, store = fleet
+
+        class FlakySource(TraceSource):
+            def __init__(self, events):
+                super().__init__(events)
+                self.fails = 2
+
+            def get(self, timeout=0.05):
+                if self.fails:
+                    self.fails -= 1
+                    raise ConnectionError("injected transport drop")
+                return super().get(timeout)
+
+        runner = _runner(store)
+        loop = WatchLoop(runner, FlakySource(
+            _events(paths, 2, digests=2)),
+            WatchConfig(debounce_s=0.0, source_backoff_max_s=0.05))
+        stats = loop.run()
+        runner.close()
+        assert stats["source_errors"] == 2
+        assert stats["scans"] == 2
+        assert _books_balance(stats)
+
+    def test_cursor_contiguous_advance(self, tmp_path):
+        cur = Cursor(str(tmp_path / "ckpt.json"))
+        cur.ack(1)
+        assert cur.position == -1     # gap at 0
+        cur.ack(0)
+        assert cur.position == 1
+        cur.ack(3); cur.ack(2)
+        assert cur.position == 3
+        # persisted + reloadable
+        cur2 = Cursor(str(tmp_path / "ckpt.json"))
+        assert cur2.position == 3
+
+    def test_cursor_torn_file_degrades_to_replay(self, tmp_path):
+        p = tmp_path / "ckpt.json"
+        p.write_text("{torn")
+        assert Cursor(str(p)).position == -1
+
+    def test_checkpoint_resume_skips_backlog(self, fleet, tmp_path):
+        paths, store = fleet
+        ckpt = str(tmp_path / "cursor.json")
+        events = _events(paths, 6, digests=3)
+
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(events),
+                         WatchConfig(debounce_s=0.0,
+                                     checkpoint_path=ckpt))
+        first = loop.run()
+        runner.close()
+        assert first["cursor"] == 5
+
+        # restart: same stream, fresh loop — the cursor makes the
+        # source skip the whole processed backlog
+        runner = _runner(store)
+        loop2 = WatchLoop(runner, TraceSource(events),
+                          WatchConfig(debounce_s=0.0,
+                                      checkpoint_path=ckpt))
+        second = loop2.run()
+        runner.close()
+        assert second["events"] == 0
+        assert second["scans"] == 0
+        assert second["cursor"] == 5
+
+    def test_synthetic_resume_partial(self, fleet, tmp_path):
+        paths, store = fleet
+        src = SyntheticSource(paths, rate=1000.0, n_events=10,
+                              seed=11, paced=False)
+        # pretend the first 6 were processed by a previous run
+        src.resume_from(5)
+        seqs = []
+        while True:
+            ev = src.get(0)
+            if ev is None and src.exhausted:
+                break
+            if ev is not None:
+                seqs.append(ev.seq)
+        assert seqs == [6, 7, 8, 9]
+
+
+# ------------------------------------------------------------------
+# e2e: events → reports byte-identical to a batch scan
+# ------------------------------------------------------------------
+
+class TestWatchE2E:
+    def test_synthetic_events_match_batch_scan(self, tmp_path):
+        paths, store = tiny_fleet(str(tmp_path), 4)
+        memo = make_findings_memo(backend="cpu-ref")
+        runner = _runner(store, memo=memo)
+        src = SyntheticSource(paths, rate=500.0, n_events=24,
+                              seed=3, paced=False)
+        loop = WatchLoop(runner, src,
+                         WatchConfig(debounce_s=0.02,
+                                     keep_results=True))
+        stats = loop.run()
+        runner.close()
+        assert stats["failed"] == 0 and stats["shed"] == 0
+        assert _books_balance(stats)
+        assert loop.results, "no results retained"
+
+        # the differential baseline: a direct (sched-off, no-memo)
+        # batch scan of the same digest set
+        batch = BatchScanRunner(store=store,
+                                backend="cpu-ref").scan_paths(paths)
+        by_name = {r.name: _norm(r) for r in batch}
+        for res in loop.results.values():
+            assert _norm(res) == by_name[res.name]
+
+
+# ------------------------------------------------------------------
+# event-storm fault scenario: storm + drain accounting race
+# ------------------------------------------------------------------
+
+class TestEventStorm:
+    def test_storm_books_balance(self, tmp_path, make_faults):
+        paths, store = tiny_fleet(str(tmp_path), 4)
+        inj = make_faults("event-storm:storm_events=64,"
+                          "storm_digests=4,storm_malformed=6")
+        spec = inj.spec
+        storm = make_event_storm(spec, paths)
+        assert len(storm) == 64 + 6
+
+        def resolver(ref, digest):
+            for p in paths:
+                if ref in p:
+                    return p
+            return None
+
+        src = WebhookSource(resolver=resolver)
+        # a small queue + tiny scheduler exercise the shed path
+        runner = _runner(store, max_queue=8)
+        loop = WatchLoop(runner, src,
+                         WatchConfig(debounce_s=0.02,
+                                     max_inflight=4,
+                                     submit_retries=1,
+                                     backoff_max_s=0.05))
+        before = WATCH_METRICS.snapshot()["malformed"]
+        accepted = malformed = 0
+
+        def push_storm():
+            for body in storm:
+                out = src.push_notification(body)
+                nonlocal accepted, malformed
+                accepted += out["accepted"]
+                malformed += out["malformed"]
+            src.close()
+
+        t = threading.Thread(target=push_storm, daemon=True)
+        t.start()
+        stats = loop.run()
+        t.join(timeout=30)
+        runner.close()
+
+        # malformed envelopes counted and dropped at the boundary
+        assert malformed == 6
+        assert WATCH_METRICS.snapshot()["malformed"] >= before + 6
+        # every accepted event ends in exactly one disposition —
+        # the loop survived the whole storm (books balance proves
+        # nothing crashed mid-flight)
+        assert stats["events"] == accepted - src.dropped
+        assert _books_balance(stats)
+        # the duplicate-tag storm collapsed: 64 events over 4
+        # digests cannot mean 64 scans
+        assert stats["scans"] < stats["events"]
+        assert stats["deduped"] > 0
+
+
+# ------------------------------------------------------------------
+# K8s admission webhook
+# ------------------------------------------------------------------
+
+def _review(images, uid="uid-1", kind="Pod"):
+    containers = {"containers": [{"name": f"c{i}", "image": ref}
+                                 for i, ref in enumerate(images)]}
+    if kind == "Pod":
+        spec = containers
+    elif kind == "CronJob":
+        spec = {"jobTemplate": {"spec": {
+            "template": {"spec": containers}}}}
+    else:                       # templated workload
+        spec = {"template": {"spec": containers}}
+    obj = {"kind": kind, "metadata": {"name": "w"}, "spec": spec}
+    return {"apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": uid, "object": obj}}
+
+
+class TestAdmissionController:
+    @pytest.fixture(scope="class")
+    def env(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("adm")
+        paths, store = tiny_fleet(str(tmp), 2)
+        holder = SwappableStore(CompiledDB.compile(store))
+        memo = make_findings_memo(backend="cpu-ref")
+        runner = _runner(holder, memo=memo)
+        resolver = lambda ref, digest: {          # noqa: E731
+            "img0": paths[0], "img1": paths[1]}.get(
+            ref.split(":")[0])
+        yield paths, holder, memo, runner, resolver
+        runner.close()
+
+    def _controller(self, env, policy="deny:HIGH,CRITICAL",
+                    fail="open", **kw):
+        paths, holder, memo, runner, resolver = env
+        return AdmissionController(
+            runner, store=holder, memo=memo,
+            policy=AdmissionPolicy.parse(policy, fail=fail),
+            resolver=resolver, default_deadline_s=60.0, **kw)
+
+    def test_policy_grammar(self):
+        p = AdmissionPolicy.parse("deny:CRITICAL,high")
+        assert p.deny == ("CRITICAL", "HIGH")
+        assert AdmissionPolicy.parse("audit").deny == ()
+        with pytest.raises(ValueError):
+            AdmissionPolicy.parse("deny:BOGUS")
+        with pytest.raises(ValueError):
+            AdmissionPolicy.parse("allow:HIGH")
+        with pytest.raises(ValueError):
+            AdmissionPolicy.parse("deny:HIGH", fail="maybe")
+
+    def test_deny_on_vulnerable_image(self, env):
+        ctl = self._controller(env)
+        out = ctl.review(_review(["img0"]))
+        resp = out["response"]
+        # tiny_fleet images carry HIGH vulns + a CRITICAL planted
+        # secret — the deny policy rejects
+        assert resp["allowed"] is False
+        assert resp["uid"] == "uid-1"
+        assert resp["status"]["reason"] == "AdmissionDenied"
+        assert "trivy-tpu/image-0" in resp["auditAnnotations"]
+
+    def test_audit_policy_never_denies(self, env):
+        ctl = self._controller(env, policy="audit")
+        resp = ctl.review(_review(["img0"]))["response"]
+        assert resp["allowed"] is True
+        assert "deny" in \
+            resp["auditAnnotations"]["trivy-tpu/image-0"] or \
+            "allow" in resp["auditAnnotations"]["trivy-tpu/image-0"]
+
+    def test_workload_template_images_extracted(self, env):
+        ctl = self._controller(env)
+        resp = ctl.review(_review(["img0"],
+                                  kind="Deployment"))["response"]
+        assert resp["allowed"] is False   # same image, same verdict
+
+    def test_verdict_cache_hits_second_review(self, env):
+        ctl = self._controller(env)
+        ctl.review(_review(["img1"]))
+        resp = ctl.review(_review(["img1"]))["response"]
+        assert "[cache]" in \
+            resp["auditAnnotations"]["trivy-tpu/image-0"]
+
+    def test_fail_open_on_unresolvable(self, env):
+        ctl = self._controller(env, fail="open")
+        resp = ctl.review(_review(["ghost-image"]))["response"]
+        assert resp["allowed"] is True
+        assert "fail-open" in \
+            resp["auditAnnotations"]["trivy-tpu/image-0"]
+
+    def test_fail_closed_on_unresolvable(self, env):
+        ctl = self._controller(env, fail="closed")
+        resp = ctl.review(_review(["ghost-image"]))["response"]
+        assert resp["allowed"] is False
+
+    def test_408_stance_raises(self, env):
+        from trivy_tpu.watch import AdmissionUnavailable
+        ctl = self._controller(env, fail="408")
+        with pytest.raises(AdmissionUnavailable):
+            ctl.review(_review(["ghost-image"]))
+
+    def test_deadline_exhaustion_fail_open_and_background(self, env):
+        ctl = self._controller(env, fail="open")
+        before = WATCH_METRICS.snapshot()
+        resp = ctl.review(_review(["img0x"], uid="u-dl"),
+                          deadline_s=1e-9)["response"]
+        after = WATCH_METRICS.snapshot()
+        assert resp["allowed"] is True
+        assert after["admission_fail_open"] > \
+            before["admission_fail_open"]
+
+    def test_malformed_reviews_raise(self, env):
+        from trivy_tpu.watch import MalformedReview
+        ctl = self._controller(env)
+        for bad in ({}, {"kind": "AdmissionReview"},
+                    {"kind": "AdmissionReview",
+                     "request": {"uid": "u"}},
+                    {"kind": "Other", "request": {"uid": "u"}}):
+            with pytest.raises(MalformedReview):
+                ctl.review(bad)
+
+
+class TestReviewRegressions:
+    """Fixes from this PR's review pass, pinned."""
+
+    def test_tag_verdict_expires_digest_verdict_does_not(
+            self, tmp_path):
+        # a MUTABLE tag ref can be repushed with new content, so its
+        # cached verdict must expire; a digest-pinned ref is
+        # content-addressed and caches until the next db swap
+        paths, store = tiny_fleet(str(tmp_path), 1)
+        runner = _runner(store)
+        ctl = AdmissionController(
+            runner, store=store,
+            policy=AdmissionPolicy.parse("deny:CRITICAL"),
+            resolver=lambda ref, digest: paths[0],
+            default_deadline_s=60.0, tag_verdict_ttl_s=0.05)
+        ann = "trivy-tpu/image-0"
+        ctl.review(_review(["app:latest"]))
+        hit = ctl.review(_review(["app:latest"]))["response"]
+        assert "[cache]" in hit["auditAnnotations"][ann]
+        time.sleep(0.08)
+        stale = ctl.review(_review(["app:latest"]))["response"]
+        assert "[cache]" not in stale["auditAnnotations"][ann], \
+            "tag verdict served past its TTL"
+        pin = "app@sha256:feed"
+        ctl.review(_review([pin]))
+        time.sleep(0.08)
+        pinned = ctl.review(_review([pin]))["response"]
+        assert "[cache]" in pinned["auditAnnotations"][ann], \
+            "digest-pinned verdict expired"
+        runner.close()
+
+    def test_webhook_overflow_acks_dropped_seqs(self, tmp_path):
+        # overflow-dropped events must not freeze the cursor: their
+        # seqs are handed to the loop for acking
+        src = WebhookSource(resolver=lambda r, d: None, maxsize=16)
+        env = {"events": [
+            {"action": "push", "target": {
+                "mediaType": MANIFEST_MEDIA_TYPES[0],
+                "repository": f"r{i}", "digest": f"sha256:{i}"}}
+            for i in range(24)]}
+        out = src.push_notification(env)
+        assert out["dropped"] == 8
+        dropped = src.take_dropped()
+        assert sorted(dropped) == list(range(8))
+        cur = Cursor("")
+        for seq in dropped:
+            cur.ack(seq)
+        # the surviving events ack normally and the cursor passes
+        # the hole the dropped ones left
+        while True:
+            ev = src.get(0)
+            if ev is None:
+                break
+            cur.ack(ev.seq)
+        assert cur.position == 23
+
+    def test_bad_json_notification_still_200(self, tmp_path):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        src = WebhookSource(resolver=lambda r, d: None)
+        server = ScanServer(sched="off", watch_source=src)
+        httpd, _ = serve(port=0, server=server)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = urllib.request.Request(
+                base + "/registry/notifications",
+                data=b"{torn json")
+            out = json.load(urllib.request.urlopen(req))
+            assert out["malformed"] == 1 and out["accepted"] == 0
+        finally:
+            httpd.shutdown()
+            server.close()
+
+    def test_resolver_shared_with_k8s(self, tmp_path):
+        from trivy_tpu.k8s import resolve_image_ref
+        from trivy_tpu.watch import dir_resolver
+        tar = tmp_path / "acme_api_v2.tar"
+        tar.write_bytes(b"x")
+        assert resolve_image_ref(str(tmp_path), "acme/api:v2") \
+            == str(tar)
+        assert dir_resolver(str(tmp_path))("acme/api:v2") \
+            == str(tar)
+
+
+class TestAdmissionCtxSwap:
+    def test_db_hot_swap_invalidates_verdicts(self, tmp_path):
+        """The satellite regression: a verdict cached under
+        generation A must NOT be served after a ``db update`` hot
+        swap — the post-swap admission reflects the new advisory
+        generation, exactly like findings-memo entries."""
+        paths, store = tiny_fleet(str(tmp_path), 2)
+        gen_a = CompiledDB.compile(AdvisoryStore())   # no advisories
+        holder = SwappableStore(gen_a)
+        memo = make_findings_memo(backend="cpu-ref")
+        runner = _runner(holder, memo=memo)
+        resolver = lambda ref, digest: paths[0]       # noqa: E731
+        ctl = AdmissionController(
+            runner, store=holder, memo=memo,
+            policy=AdmissionPolicy.parse("deny:HIGH"),
+            resolver=resolver, default_deadline_s=60.0,
+            security_checks=["vuln"])                 # vulns only
+
+        resp = ctl.review(_review(["img0"]))["response"]
+        assert resp["allowed"] is True                # gen A: clean
+        resp = ctl.review(_review(["img0"]))["response"]
+        assert "[cache]" in \
+            resp["auditAnnotations"]["trivy-tpu/image-0"]
+
+        holder.swap(CompiledDB.compile(store))        # gen B: HIGHs
+        resp = ctl.review(_review(["img0"]))["response"]
+        assert resp["allowed"] is False, \
+            "post-swap admission served a stale generation verdict"
+        assert "[cache]" not in \
+            resp["auditAnnotations"]["trivy-tpu/image-0"]
+        runner.close()
+
+
+class TestAdmissionHTTP:
+    """The webhook over real HTTP: the seeded AdmissionReview corpus
+    exercises allow / deny / fail-open / 408 / malformed / the
+    apiserver ?timeout parameter."""
+
+    @pytest.fixture(scope="class")
+    def served(self, tmp_path_factory):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        tmp = tmp_path_factory.mktemp("adm-http")
+        paths, store = tiny_fleet(str(tmp), 2)
+        holder = SwappableStore(CompiledDB.compile(store))
+        memo = make_findings_memo(backend="cpu-ref")
+        runner = _runner(holder, memo=memo)
+        resolver = lambda ref, digest: {              # noqa: E731
+            "img0": paths[0], "img1": paths[1]}.get(ref)
+        # a CLEAN image for the allow path: no packages, no secrets
+        from trivy_tpu.utils.synth import write_image_tar
+        clean = str(tmp / "clean.tar")
+        write_image_tar(clean, [{"etc/motd": b"hello\n"}],
+                        "clean/img:1")
+        resolver2 = lambda ref, digest: (             # noqa: E731
+            clean if ref == "clean" else resolver(ref, digest))
+        ctl = AdmissionController(
+            runner, store=holder, memo=memo,
+            policy=AdmissionPolicy.parse("deny:HIGH,CRITICAL",
+                                         fail="408"),
+            resolver=resolver2, default_deadline_s=60.0)
+        server = ScanServer(store=holder, sched=runner.scheduler,
+                            memo=memo, admission=ctl)
+        httpd, _ = serve(port=0, server=server)
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", ctl
+        httpd.shutdown()
+        runner.close()
+
+    def _post(self, base, doc, path="/k8s/admission"):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.load(urllib.request.urlopen(req))
+
+    def test_corpus_allow_deny_over_http(self, served):
+        base, _ = served
+        import random
+        rng = random.Random(20260804)
+        verdicts = {}
+        for i in range(6):
+            kind = rng.choice(["Pod", "Deployment", "CronJob"])
+            ref = rng.choice(["img0", "img1", "clean"])
+            out = self._post(base, _review([ref], uid=f"u{i}",
+                                           kind=kind))
+            assert out["kind"] == "AdmissionReview"
+            assert out["response"]["uid"] == f"u{i}"
+            verdicts.setdefault(ref, set()).add(
+                out["response"]["allowed"])
+        assert verdicts.get("clean", set()) <= {True}
+        for ref in ("img0", "img1"):
+            if ref in verdicts:
+                assert verdicts[ref] == {False}
+
+    def test_timeout_query_param_408(self, served):
+        base, _ = served
+        # the 408 stance + an impossible apiserver timeout: the
+        # deadline surfaces as HTTP 408 (K8s failurePolicy decides)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base, _review(["img0-cold-miss"], uid="ux"),
+                       path="/k8s/admission?timeout=0.000001s")
+        assert ei.value.code == 408
+
+    def test_malformed_review_400(self, served):
+        base, _ = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base, {"kind": "nope"})
+        assert ei.value.code == 400
+
+    def test_bad_timeout_param_400(self, served):
+        base, _ = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base, _review(["clean"]),
+                       path="/k8s/admission?timeout=bogus")
+        assert ei.value.code == 400
+
+    def test_admission_404_when_unmounted(self):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        server = ScanServer(sched="off")
+        httpd, _ = serve(port=0, server=server)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base, _review(["x"]))
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base, {"events": []},
+                           path="/registry/notifications")
+            assert ei.value.code == 404
+        finally:
+            httpd.shutdown()
+            server.close()
+
+
+# ------------------------------------------------------------------
+# metrics surface (obs satellite): JSON + prom, both sched modes
+# ------------------------------------------------------------------
+
+@pytest.mark.obs
+class TestWatchMetricsSurface:
+    def _families(self, text):
+        return [
+            "trivy_tpu_watch_events_total",
+            "trivy_tpu_watch_deduped_total",
+            "trivy_tpu_watch_scans_total",
+            "trivy_tpu_watch_shed_total",
+            "trivy_tpu_watch_malformed_total",
+            "trivy_tpu_admission_allow_total",
+            "trivy_tpu_admission_deny_total",
+            "trivy_tpu_admission_fail_open_total",
+            "trivy_tpu_admission_timeout_total",
+            "trivy_tpu_watch_lag_seconds_bucket",
+            "trivy_tpu_admission_latency_seconds_bucket",
+        ]
+
+    def test_sched_off_server_surfaces_watch(self):
+        from trivy_tpu.rpc.server import ScanServer
+        server = ScanServer(sched="off")
+        try:
+            snap = server.metrics()
+            assert "watch" in snap
+            for k in ("events", "deduped", "scans", "shed",
+                      "admission_allow", "admission_deny"):
+                assert k in snap["watch"]
+            text = server.metrics_text()
+            for fam in self._families(text):
+                assert fam in text, fam
+        finally:
+            server.close()
+
+    def test_sched_on_server_surfaces_watch(self):
+        from trivy_tpu.rpc.server import ScanServer
+        server = ScanServer(sched="on")
+        try:
+            snap = server.metrics()
+            assert "watch" in snap
+            text = server.metrics_text()
+            for fam in self._families(text):
+                assert fam in text, fam
+            # openmetrics variant still renders (exemplar path)
+            om = server.metrics_text(openmetrics=True)
+            assert om.rstrip().endswith("# EOF")
+        finally:
+            server.close()
+
+    def test_lag_exemplars_carry_trace_ids(self, tmp_path):
+        paths, store = tiny_fleet(str(tmp_path), 2)
+        runner = _runner(store)
+        loop = WatchLoop(runner, TraceSource(
+            _events(paths, 2, digests=2)),
+            WatchConfig(debounce_s=0.0))
+        loop.run()
+        runner.close()
+        hists = WATCH_METRICS.hist_snapshot()
+        ex = hists["watch_lag"]["exemplars"]
+        assert ex, "watch lag histogram recorded no exemplars"
+        trace_id = next(iter(ex.values()))[0]
+        assert trace_id and all(
+            c in "0123456789abcdef" for c in trace_id)

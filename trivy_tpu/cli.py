@@ -28,6 +28,27 @@ from .types import (Metadata, Report, ScanOptions, Severity,
 DEFAULT_SEVERITIES = "UNKNOWN,LOW,MEDIUM,HIGH,CRITICAL"
 
 
+def _admission_flags(sp) -> None:
+    """K8s validating-admission webhook knobs (docs/serving.md
+    'Continuous scanning & admission control') — shared by the
+    server and the watch command (both mount POST /k8s/admission)."""
+    sp.add_argument("--admission-policy", default="deny:CRITICAL",
+                    help="severity policy for POST /k8s/admission: "
+                    "'deny:SEV[,SEV...]' denies pods whose images "
+                    "carry findings at those severities; 'audit' "
+                    "never denies (annotations only)")
+    sp.add_argument("--admission-fail", default="open",
+                    choices=["open", "closed", "408"],
+                    help="stance when a verdict cannot resolve "
+                    "inside the deadline: open = allow + annotate, "
+                    "closed = deny, 408 = surface HTTP 408 and let "
+                    "the webhook's K8s failurePolicy decide")
+    sp.add_argument("--admission-deadline", type=float, default=10.0,
+                    help="default verdict deadline in seconds "
+                    "(the apiserver's ?timeout= overrides per "
+                    "request)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="trivy-tpu",
@@ -292,6 +313,50 @@ def build_parser() -> argparse.ArgumentParser:
                      "a YAML spec file")
     scan_flags(k8s)
 
+    watch = sub.add_parser(
+        "watch", help="continuous scanning: subscribe to registry "
+        "push events (Docker Registry v2 notification webhooks, or "
+        "a seeded synthetic source) and keep the fleet scanned "
+        "(docs/serving.md 'Continuous scanning & admission "
+        "control')")
+    watch.add_argument("target", nargs="*", default=[],
+                       help="image tarballs the synthetic source "
+                       "draws push events from (webhook sources "
+                       "resolve refs via --images-dir instead)")
+    watch.add_argument("--watch-source", default="webhook",
+                       help="event source: 'webhook' (serve "
+                       "POST /registry/notifications on --listen) "
+                       "or 'synthetic[:rate=5,n=64,seed=7]' "
+                       "(seeded Poisson replay over the targets)")
+    watch.add_argument("--listen", default="127.0.0.1:4956",
+                       help="host:port for the HTTP plane "
+                       "(notification webhook, /metrics, "
+                       "/k8s/admission); synthetic runs skip it "
+                       "with --listen ''")
+    watch.add_argument("--images-dir", default="",
+                       help="resolve pushed image refs to local "
+                       "tarballs named <ref with /:@ as _>.tar "
+                       "(the k8s --images-dir contract)")
+    watch.add_argument("--debounce-ms", type=float, default=250.0,
+                       help="per-digest debounce window: a tag "
+                       "repushed in a burst scans once")
+    watch.add_argument("--max-inflight", type=int, default=32,
+                       help="in-flight watermark: stop pulling the "
+                       "event source at this many outstanding scans")
+    watch.add_argument("--checkpoint", default="",
+                       help="cursor checkpoint file: a restarted "
+                       "watch resumes after the last resolved event "
+                       "instead of re-scanning the backlog")
+    watch.add_argument("--watch-tenant", default="watch",
+                       help="tenant identity watch submissions "
+                       "carry (QoS/SLO scoping, docs/serving.md)")
+    watch.add_argument("--watch-priority", type=int, default=0)
+    watch.add_argument("--max-events", type=int, default=0,
+                       help="stop after this many events "
+                       "(0 = run until SIGINT / source exhausted)")
+    _admission_flags(watch)
+    scan_flags(watch)
+
     aws = sub.add_parser(
         "aws", help="scan AWS account state (exported account-state "
         "JSON; live API walk is a seam)")
@@ -392,6 +457,12 @@ def build_parser() -> argparse.ArgumentParser:
                      "objective=0.95,threshold_s=2.5' — burn-rate "
                      "verdicts at GET /slo, gauges on /metrics; "
                      "default: 99% availability + 95% under 30s")
+    _admission_flags(srv)
+    srv.add_argument("--images-dir", default="",
+                     help="resolve admission-webhook image refs to "
+                     "local tarballs named <ref with /:@ as _>.tar; "
+                     "without it admission misses apply the fail "
+                     "stance")
     srv.add_argument("--profile-out", default="",
                      help="opt-in device trace: jax.profiler trace "
                      "into this directory plus the host profiler's "
@@ -436,9 +507,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
-                   "sbom", "k8s", "aws", "db", "server", "plugin",
-                   "config", "conf", "module", "m", "client", "c",
-                   "version")
+                   "sbom", "k8s", "aws", "db", "server", "watch",
+                   "plugin", "config", "conf", "module", "m",
+                   "client", "c", "version")
 
 
 def main(argv=None) -> int:
@@ -562,6 +633,8 @@ def _dispatch(args) -> int:
         return run_db(args)
     if args.command == "server":
         return run_server(args)
+    if args.command == "watch":
+        return run_watch(args)
     if args.command == "k8s":
         return run_k8s(args)
     if args.command == "plugin":
@@ -795,7 +868,17 @@ def run_server(args) -> int:
         else:
             print(f"error: {e}", file=sys.stderr)
             return 1
+    _trace_out(args)
+    slos = None
+    if getattr(args, "slo_config", ""):
+        from .obs.slo import parse_slo_config
+        try:
+            slos = parse_slo_config(args.slo_config)
+        except ValueError as e:
+            print(f"error: --slo-config: {e}", file=sys.stderr)
+            return 2
     sched = "off"
+    scheduler = None
     if getattr(args, "sched", "on") == "on":
         try:
             cfg = _sched_config(args)
@@ -811,30 +894,246 @@ def run_server(args) -> int:
                 print(f"error: --sched-deadline: {e}",
                       file=sys.stderr)
                 return 2
-        sched = cfg
-    _trace_out(args)
-    slos = None
-    if getattr(args, "slo_config", ""):
-        from .obs.slo import parse_slo_config
-        try:
-            slos = parse_slo_config(args.slo_config)
-        except ValueError as e:
-            print(f"error: --slo-config: {e}", file=sys.stderr)
-            return 2
+        if slos is not None:
+            cfg.slos = slos
+        # the scheduler is built HERE (not inside ScanServer) so the
+        # admission webhook's image scans share it — and so it
+        # carries a secret scanner, which blob-only RPC scans never
+        # needed but admission-path image loads do
+        from .secret.batch import BatchSecretScanner
+        from .sched import ScanScheduler
+        scheduler = ScanScheduler(
+            config=cfg, backend="tpu",
+            secret_scanner=BatchSecretScanner(backend="tpu"))
+        sched = scheduler
     injector = _fault_injector(args)
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
                         token_header=args.token_header,
-                        sched=sched, slos=slos,
+                        sched=sched,
+                        slos=None if scheduler is not None else slos,
                         memo=_memo(args, injector=injector))
     server.fault_injector = injector
+    adm_runner = None
+    try:
+        server.admission, adm_runner = _admission_controller(
+            args, server)
+    except ValueError as e:
+        print(f"error: --admission-policy: {e}", file=sys.stderr)
+        return 2
     print(f"trivy-tpu server listening on {args.listen}")
-    serve_forever(host or "127.0.0.1", int(port), server,
-                  db_watch_prefix=args.compiled_db,
-                  db_watch_interval_s=args.db_watch_interval,
-                  drain_timeout_s=getattr(args, "drain_timeout",
-                                          30.0))
+    try:
+        serve_forever(host or "127.0.0.1", int(port), server,
+                      db_watch_prefix=args.compiled_db,
+                      db_watch_interval_s=args.db_watch_interval,
+                      drain_timeout_s=getattr(args, "drain_timeout",
+                                              30.0))
+    finally:
+        if adm_runner is not None:
+            adm_runner.close()
+        if scheduler is not None:
+            scheduler.close()
+    return 0
+
+
+def _admission_controller(args, server) -> tuple:
+    """Mount POST /k8s/admission: an AdmissionController whose scans
+    ride the server's scheduler, store (hot-swap aware), cache, and
+    findings memo — warm memo entries make the common admission a
+    sub-second cache hit (docs/serving.md)."""
+    from .runtime import BatchScanRunner
+    from .watch import AdmissionController, AdmissionPolicy
+    from .watch import dir_resolver
+    policy = AdmissionPolicy.parse(
+        getattr(args, "admission_policy", ""),
+        fail=getattr(args, "admission_fail", "open"))
+    resolver = None
+    if getattr(args, "images_dir", ""):
+        resolver = dir_resolver(args.images_dir)
+    runner = BatchScanRunner(
+        store=server.store, cache=server.cache,
+        # the watch command lets the operator pick the backend; the
+        # server has no --backend flag and defaults to tpu
+        backend=getattr(args, "backend", "tpu"),
+        sched=(server.scheduler if server.scheduler is not None
+               else "on"),
+        memo=server.memo)
+    controller = AdmissionController(
+        runner, store=server.store, memo=server.memo,
+        policy=policy, resolver=resolver,
+        default_deadline_s=getattr(args, "admission_deadline",
+                                   10.0))
+    return controller, runner
+
+
+def run_watch(args) -> int:
+    """``trivy-tpu watch``: the event-driven continuous-scanning
+    runtime (docs/serving.md "Continuous scanning & admission
+    control") — an event source feeds the debounced watch loop,
+    scans ride the continuous-batching scheduler with the watch
+    tenant identity, and (when listening) the HTTP plane serves the
+    registry-notification webhook, /metrics, and /k8s/admission."""
+    import signal
+
+    from .db.compiled import SwappableStore
+    from .runtime import BatchScanRunner
+    from .watch import (SyntheticSource, WatchConfig, WatchLoop,
+                        WebhookSource, dir_resolver,
+                        make_event_storm)
+
+    try:
+        store = _store(args)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    holder = SwappableStore(store)
+    opt = _artifact_option(args)
+    injector = _fault_injector(args)
+    cache = _cache(args)
+    if injector is not None:
+        cache = injector.wrap_cache(cache)
+    memo = _memo(args, cache, option=opt, injector=injector)
+    try:
+        sched_config = _sched_config(args)
+    except ValueError as e:
+        print(f"error: --tenant-config: {e}", file=sys.stderr)
+        return 2
+    runner = BatchScanRunner(
+        store=holder, cache=cache, backend=args.backend,
+        secret_scanner=opt.secret_scanner, sched=sched_config,
+        artifact_option=opt, fault_injector=injector, memo=memo)
+
+    targets = args.target if isinstance(args.target, list) \
+        else ([args.target] if args.target else [])
+    resolver = dir_resolver(args.images_dir) \
+        if args.images_dir else None
+    spec_text = (args.watch_source or "webhook").strip()
+    kind, _, rest = spec_text.partition(":")
+    if kind == "synthetic":
+        if not targets:
+            print("error: the synthetic source needs image-tarball "
+                  "targets", file=sys.stderr)
+            return 2
+        kw = {"rate": 5.0, "n": 0, "seed": 20260804, "dup": 0.25}
+        for pair in rest.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            k, eq, v = pair.partition("=")
+            if not eq or k not in kw:
+                print(f"error: bad --watch-source entry {pair!r}",
+                      file=sys.stderr)
+                return 2
+            try:
+                kw[k] = type(kw[k])(v)
+            except (TypeError, ValueError):
+                print(f"error: bad --watch-source value {v!r}",
+                      file=sys.stderr)
+                return 2
+        source = SyntheticSource(
+            targets, rate=kw["rate"], n_events=int(kw["n"]),
+            seed=int(kw["seed"]), dup_rate=kw["dup"],
+            tenant=args.watch_tenant, priority=args.watch_priority)
+    elif kind == "webhook":
+        source = WebhookSource(resolver=resolver,
+                               tenant=args.watch_tenant,
+                               priority=args.watch_priority)
+    else:
+        print(f"error: unknown --watch-source {spec_text!r} "
+              "(want webhook or synthetic[:k=v,...])",
+              file=sys.stderr)
+        return 2
+
+    cfg = WatchConfig(
+        debounce_s=max(0.0, args.debounce_ms) / 1000.0,
+        max_inflight=max(1, args.max_inflight),
+        tenant=args.watch_tenant, priority=args.watch_priority,
+        checkpoint_path=args.checkpoint)
+    loop = WatchLoop(runner, source, cfg,
+                     options=_scan_options(args))
+
+    httpd = adm_runner = None
+    if args.listen:
+        from .rpc.server import ScanServer, serve
+        host, _, port = args.listen.rpartition(":")
+        if not port.isdigit():
+            print(f"error: --listen needs host:port, got "
+                  f"{args.listen!r}", file=sys.stderr)
+            return 2
+        server = ScanServer(store=holder, cache=cache,
+                            token=args.auth_token,
+                            token_header=args.token_header,
+                            sched=runner.scheduler, memo=memo)
+        if isinstance(source, WebhookSource):
+            server.watch_source = source
+        try:
+            server.admission, adm_runner = _admission_controller(
+                args, server)
+        except ValueError as e:
+            print(f"error: --admission-policy: {e}",
+                  file=sys.stderr)
+            return 2
+        httpd, _ = serve(host or "127.0.0.1", int(port), server,
+                         db_watch_prefix=args.compiled_db)
+        print(f"trivy-tpu watch listening on {args.listen}",
+              file=sys.stderr)
+    elif memo is not None:
+        # no HTTP plane constructed the memo<->store swap hook:
+        # attach it here so db hot swaps still delta-re-match
+        from .db.lifecycle import attach_memo
+        attach_memo(holder, memo)
+
+    if injector is not None and injector.spec.wants_event_storm():
+        if not isinstance(source, WebhookSource) or not targets:
+            print("error: event-storm needs the webhook source and "
+                  "image-tarball targets", file=sys.stderr)
+            return 2
+        storm = make_event_storm(injector.spec, targets)
+        # storm repositories are the target tarballs' basenames —
+        # resolve them back to the listed targets (falling through
+        # to the --images-dir resolver for anything else), or every
+        # storm event would shed unresolvable and the drill would
+        # prove nothing about debounce/backpressure
+        by_ref = {os.path.basename(p): p for p in targets}
+        outer = source.resolver
+
+        def storm_resolver(ref, digest="", _outer=outer):
+            hit = by_ref.get(ref.split(":")[0])
+            if hit is not None:
+                return hit
+            return _outer(ref, digest) if _outer else None
+
+        source.resolver = storm_resolver
+        for body in storm:
+            source.push_notification(body)
+        print(f"fault-spec: pushed {len(storm)} storm "
+              f"notifications (seed={injector.spec.seed})",
+              file=sys.stderr)
+        source.close()       # the storm IS the stream: drain + exit
+
+    stop = []
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda *_: (stop.append(1), loop.close()))
+    except ValueError:
+        pass                 # not the main thread (tests)
+    try:
+        while loop.step():
+            if args.max_events and \
+                    loop.counters["events"] >= args.max_events:
+                break
+            if stop:
+                break
+    except KeyboardInterrupt:
+        pass
+    stats = loop.drain()
+    if httpd is not None:
+        httpd.shutdown()
+    if adm_runner is not None:
+        adm_runner.close()
+    runner.close()
+    print(json.dumps({"watch": stats}, indent=2), file=sys.stderr)
     return 0
 
 

@@ -68,6 +68,18 @@ class FaultSpec:
     #    (scan completes ok, byte-identical to cold)
     memo_corrupt_loads: int = 0
 
+    # -- event storm (docs/serving.md "Continuous scanning"): a
+    #    burst of storm_events registry push notifications over
+    #    storm_digests distinct digests (duplicate-tag repushes) with
+    #    storm_malformed malformed envelopes interleaved. The harness
+    #    (watch.source.make_event_storm) materializes the seeded
+    #    burst; the watch loop must collapse duplicates via debounce,
+    #    count-and-drop malformed envelopes, shed overload through
+    #    the existing 429/503 paths, and never crash
+    storm_events: int = 0
+    storm_digests: int = 0
+    storm_malformed: int = 0
+
     # -- tenant flood (docs/serving.md "Multi-tenant QoS"): like
     #    deadline-storm, the spec only carries the storm's shape —
     #    the harness (bench.py adversarial-tenant arm, tests) runs
@@ -95,6 +107,9 @@ class FaultSpec:
     def wants_memo_faults(self) -> bool:
         return bool(self.memo_corrupt_loads)
 
+    def wants_event_storm(self) -> bool:
+        return bool(self.storm_events)
+
 
 # Named presets. ``standard-outage`` is the bench/acceptance scenario:
 # a cache outage long enough to trip the breaker and recover, one
@@ -119,6 +134,8 @@ SCENARIOS: dict = {
     "memo-poison": {"memo_corrupt_loads": 4},
     "tenant-flood": {"flood_tenant": "flooder", "flood_rate": 400.0,
                      "flood_n": 256},
+    "event-storm": {"storm_events": 256, "storm_digests": 8,
+                    "storm_malformed": 8},
 }
 
 _FIELDS = {f.name: f for f in fields(FaultSpec)}

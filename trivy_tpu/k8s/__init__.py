@@ -100,6 +100,16 @@ def _images(doc: dict) -> list:
             for c in pod.get(key) or [] if c.get("image")]
 
 
+def images_from_object(doc: dict) -> list:
+    """Pod-spec image references of one workload object — bare Pods
+    and every templated WORKLOAD_KIND alike. Shared by the cluster
+    scanner and the admission webhook (watch/admission.py), so both
+    agree on what "the images of this object" means."""
+    if not isinstance(doc, dict):
+        return []
+    return _images(doc)
+
+
 class ManifestClient:
     """Artifact enumerator over manifest files — the stand-in for the
     live-cluster client (same ``artifacts()`` contract)."""
@@ -144,6 +154,21 @@ class ManifestClient:
 
 def _sanitize_ref(ref: str) -> str:
     return re.sub(r"[/:@]", "_", ref)
+
+
+def resolve_image_ref(images_dir: str, ref: str) -> Optional[str]:
+    """image ref → local tarball named ``<ref with /:@ as _>.tar``
+    (the zero-egress stand-in for a registry pull). ONE copy of the
+    naming contract — the cluster scanner and the watch/admission
+    resolvers (watch/source.dir_resolver) both call it."""
+    if not images_dir:
+        return None
+    for cand in (f"{_sanitize_ref(ref)}.tar",
+                 f"{_sanitize_ref(ref.split('/')[-1])}.tar"):
+        path = os.path.join(images_dir, cand)
+        if os.path.exists(path):
+            return path
+    return None
 
 
 class K8sScanner:
@@ -246,13 +271,4 @@ class K8sScanner:
         return out
 
     def _resolve(self, ref: str) -> Optional[str]:
-        """image ref → local tarball (zero-egress stand-in for the
-        registry pull the reference does via the artifact runner)."""
-        if not self.images_dir:
-            return None
-        for cand in (f"{_sanitize_ref(ref)}.tar",
-                     f"{_sanitize_ref(ref.split('/')[-1])}.tar"):
-            path = os.path.join(self.images_dir, cand)
-            if os.path.exists(path):
-                return path
-        return None
+        return resolve_image_ref(self.images_dir, ref)

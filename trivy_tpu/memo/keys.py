@@ -174,3 +174,12 @@ def query_sig(q) -> str:
 
 def entry_checksum(entry: dict) -> str:
     return _sha(cjson(entry), 32)
+
+
+def verdict_sig(ctx: str, image: str, policy: str) -> str:
+    """Admission-verdict cache key (watch/admission.py): the memo
+    context signature folds the advisory generation (and rule-set /
+    guard-config / scanner-version) in, so a ``db update`` hot swap
+    strands cached admission verdicts exactly like findings
+    entries — the new generation keys differently and recomputes."""
+    return _sha(cjson(["admission", ctx, image, policy]), 40)

@@ -119,6 +119,7 @@ def render_prometheus(stats: dict, phase_hists=None,
                       trace_hists=None, tenant_hists=None,
                       tracer_stats=None,
                       recorder_stats=None,
+                      watch_hists=None,
                       openmetrics: bool = False) -> str:
     """Render the ``/metrics`` snapshot dict as Prometheus text.
 
@@ -278,6 +279,57 @@ def render_prometheus(stats: dict, phase_hists=None,
                   "rematch_jobs", "rematch_entries", "swaps"):
             if k in memo:
                 w.sample(name, [("event", k)], memo[k])
+
+    watch = stats.get("watch") or {}
+    if watch:
+        # watch-loop event dispositions + admission verdicts
+        # (docs/serving.md "Continuous scanning & admission
+        # control"): every valid event ends in exactly one of
+        # scans/deduped/shed — the three totals plus events must
+        # balance, which makes them alertable
+        for k, help_ in (
+                ("events", "Push events admitted by the watch "
+                 "loop."),
+                ("deduped", "Events folded into a pending or "
+                 "in-flight scan of the same digest."),
+                ("scans", "Debounced scan submissions."),
+                ("shed", "Events shed by admission backpressure "
+                 "or unresolvable references."),
+                ("malformed", "Malformed registry notifications "
+                 "counted and dropped at the parse boundary.")):
+            w.scalar(f"{_PREFIX}_watch_{k}_total", "counter",
+                     help_, watch.get(k))
+        name = f"{_PREFIX}_watch_events_detail_total"
+        w.header(name, "counter",
+                 "Watch-loop bookkeeping (scan outcomes, source "
+                 "errors, unresolvable references).")
+        for k in ("completed", "failed", "source_errors",
+                  "unresolvable"):
+            if k in watch:
+                w.sample(name, [("event", k)], watch[k])
+        for k, help_ in (
+                ("allow", "Admission reviews answered allowed."),
+                ("deny", "Admission reviews answered denied."),
+                ("fail_open", "Images admitted fail-open after a "
+                 "deadline or scan failure."),
+                ("timeout", "Admission scans that missed their "
+                 "deadline.")):
+            w.scalar(f"{_PREFIX}_admission_{k}_total", "counter",
+                     help_, watch.get(f"admission_{k}"))
+        name = f"{_PREFIX}_admission_events_total"
+        w.header(name, "counter",
+                 "Admission bookkeeping (reviews, verdict-cache "
+                 "traffic, background warm scans).")
+        for k in ("admission_reviews", "admission_cache_hits",
+                  "admission_cache_misses",
+                  "admission_background_scans"):
+            if k in watch:
+                w.sample(name,
+                         [("event", k[len("admission_"):])],
+                         watch[k])
+        w.scalar(f"{_PREFIX}_admission_cache_hit_rate", "gauge",
+                 "Admission verdict-cache hit rate.",
+                 watch.get("admission_cache_hit_rate"))
 
     tenants = stats.get("tenants") or {}
     if tenants:
@@ -443,6 +495,17 @@ def render_prometheus(stats: dict, phase_hists=None,
                 "Per-tenant request latency (admission to "
                 "resolution) — the fairness/QoS signal.",
                 openmetrics)
+    wh = watch_hists or {}
+    _histograms(w, "watch_lag", "stage",
+                {"complete": wh["watch_lag"]}
+                if "watch_lag" in wh else {},
+                "Push-event lag: registry event arrival to scan "
+                "resolution.", openmetrics)
+    _histograms(w, "admission_latency", "stage",
+                {"review": wh["admission_latency"]}
+                if "admission_latency" in wh else {},
+                "K8s admission review latency (wall time of "
+                "POST /k8s/admission).", openmetrics)
 
     if openmetrics:
         w.lines.append("# EOF")

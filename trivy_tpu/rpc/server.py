@@ -195,7 +195,8 @@ class ScanServer:
                  sched: str = "off", sched_config=None,
                  max_body_bytes: int = MAX_BODY_BYTES,
                  max_scan_blobs: int = MAX_SCAN_BLOBS,
-                 tracer=None, slos=None, memo=None):
+                 tracer=None, slos=None, memo=None,
+                 admission=None, watch_source=None):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -272,6 +273,13 @@ class ScanServer:
         # GET /debug/profile (TRIVY_TPU_PROFILE=off disables)
         from ..obs.profiler import get_profiler
         self.profiler = get_profiler()
+        # continuous-scanning front-ends (docs/serving.md
+        # "Continuous scanning & admission control"):
+        # admission: watch.AdmissionController answering
+        # POST /k8s/admission (404 when unset); watch_source:
+        # watch.WebhookSource fed by POST /registry/notifications
+        self.admission = admission
+        self.watch_source = watch_source
 
     def close(self) -> None:
         # only tear down a scheduler this server constructed — an
@@ -485,6 +493,14 @@ class ScanServer:
             out["memo"] = MEMO_METRICS.snapshot()
         if self.memo is not None:
             out["memo"] = self.memo.stats()
+        if "watch" not in out:
+            # watch/admission counters (docs/serving.md
+            # "Continuous scanning") — sched-off servers report
+            # them too
+            from ..watch.metrics import WATCH_METRICS
+            out["watch"] = WATCH_METRICS.snapshot()
+        if self.admission is not None:
+            out["admission_controller"] = self.admission.stats()
         if "slo" not in out:
             out["slo"] = self.slo.snapshot()
         out["profiler"] = self.profiler.stats()
@@ -504,6 +520,7 @@ class ScanServer:
         negotiates ``application/openmetrics-text``
         (docs/observability.md has a scrape config)."""
         from ..obs.prom import render_prometheus
+        from ..watch.metrics import WATCH_METRICS
         phase = self.scheduler.metrics.hist_snapshot() \
             if self.scheduler is not None else None
         tenant = self.scheduler.queue.book.hist_snapshot() \
@@ -514,6 +531,7 @@ class ScanServer:
             tenant_hists=tenant,
             tracer_stats=self.tracer.stats(),
             recorder_stats=self.tracer.recorder.stats(),
+            watch_hists=WATCH_METRICS.hist_snapshot(),
             openmetrics=openmetrics)
 
     def trace(self, trace_id: str):
@@ -731,6 +749,17 @@ def _make_handler(server: ScanServer):
             try:
                 body = json.loads(raw or b"{}")
             except ValueError:
+                if self.path.split("?", 1)[0] == \
+                        "/registry/notifications" and \
+                        server.watch_source is not None:
+                    # the notification route's always-200 contract
+                    # covers non-JSON poison too: a registry
+                    # redelivers on non-2xx forever; count it as
+                    # one malformed envelope and move on
+                    self._reply(200,
+                                server.watch_source
+                                .push_notification(None))
+                    return
                 self._reply(400, {"code": "malformed",
                                   "msg": "invalid json body"})
                 return
@@ -740,6 +769,24 @@ def _make_handler(server: ScanServer):
             if tenant_hdr and isinstance(body, dict) \
                     and not body.get("tenant"):
                 body["tenant"] = tenant_hdr
+            # continuous-scanning routes (docs/serving.md): the
+            # registry notification webhook and the K8s admission
+            # webhook answer their own protocols, not twirp
+            if self.path.split("?", 1)[0] == \
+                    "/registry/notifications":
+                if server.watch_source is None:
+                    self._reply(404, {"code": "bad_route",
+                                      "msg": self.path})
+                    return
+                # always 200: a registry redelivers on non-2xx, and
+                # a poison envelope must not be redelivered forever —
+                # malformed events are counted and dropped
+                self._reply(
+                    200, server.watch_source.push_notification(body))
+                return
+            if self.path.split("?", 1)[0] == "/k8s/admission":
+                self._handle_admission(body)
+                return
             from ..sched import DeadlineExceeded, SchedulerClosed
             try:
                 out = server.handle(self.path, body)
@@ -802,6 +849,51 @@ def _make_handler(server: ScanServer):
                 self.close_connection = True
                 return
             self._reply(200, out)
+
+        def _handle_admission(self, body: dict) -> None:
+            """POST /k8s/admission: AdmissionReview in, review out.
+            The apiserver's ``?timeout=10s`` query parameter bounds
+            the verdict (PR-1's deadline machinery underneath); the
+            fail stance decides what a miss answers — only the
+            explicit ``408`` stance surfaces the deadline as HTTP,
+            handing the decision to the webhook's K8s-side
+            ``failurePolicy``."""
+            from urllib.parse import parse_qs, urlsplit
+
+            from ..watch.admission import (AdmissionUnavailable,
+                                           MalformedReview)
+            if server.admission is None:
+                self._reply(404, {"code": "bad_route",
+                                  "msg": self.path})
+                return
+            deadline_s = 0.0
+            q = parse_qs(urlsplit(self.path).query)
+            if q.get("timeout"):
+                raw = q["timeout"][0].strip()
+                try:
+                    deadline_s = float(raw[:-1]) \
+                        if raw.endswith("s") else float(raw)
+                except (TypeError, ValueError):
+                    self._reply(400, {"code": "malformed",
+                                      "msg": "bad timeout= value"})
+                    return
+            try:
+                doc = server.admission.review(body,
+                                              deadline_s=deadline_s)
+            except MalformedReview as e:
+                self._reply(400, {"code": "malformed",
+                                  "msg": str(e)})
+                return
+            except AdmissionUnavailable as e:
+                self._reply(408, {"code": "deadline_exceeded",
+                                  "msg": str(e)})
+                return
+            except Exception as e:      # noqa: BLE001
+                log.warning("admission review failed: %r", e)
+                self._reply(500, {"code": "internal",
+                                  "msg": str(e)})
+                return
+            self._reply(200, doc)
 
     return Handler
 

@@ -141,6 +141,18 @@ class BatchScanRunner:
             self._scheduler.close()
             self._scheduler = None
 
+    def _store_view(self) -> tuple:
+        """``(db, release|None)``: a SwappableStore holder (the
+        server's hot-swap contract, now honored by embedders — the
+        watch runtime and the admission webhook front long-lived
+        runners whose advisory DB updates underneath them) is
+        acquired per scan so a ``db update`` swap waits for
+        in-flight work; plain stores pass through untouched."""
+        s = self.store
+        if hasattr(s, "acquire") and hasattr(s, "release"):
+            return s.acquire(), s.release
+        return s, None
+
     def scan_paths(self, paths: list,
                    options: Optional[ScanOptions] = None) -> list:
         if self.sched == "on":
@@ -287,6 +299,22 @@ class BatchScanRunner:
                 # slot only; a slow-host stall eats into the deadline
                 inj.on_host_analyze(name)
                 inj.on_image_load(name)
+            db, release = self._store_view()
+            if release is not None:
+                # the reader is held from analyze to resolution so a
+                # DB hot swap waits for this scan (the server's
+                # acquire/release contract); chained AFTER any
+                # caller-provided on_done, released exactly once at
+                # whatever resolution path fires first
+                prev = req.on_done
+
+                def _done(r, _prev=prev, _rel=release):
+                    try:
+                        if _prev is not None:
+                            _prev(r)
+                    finally:
+                        _rel()
+                req.on_done = _done
             budget = self._ingest_budget(name)
             img = image if image is not None \
                 else load_image(name, budget=budget)
@@ -308,7 +336,7 @@ class BatchScanRunner:
                 # with ingest-stage causes
                 for kind, msg in a.budget.soft_faults:
                     req.record_fault("ingest", kind, msg)
-            scanner = LocalScanner(self.cache, self.store,
+            scanner = LocalScanner(self.cache, db,
                                    memo=self.memo)
             prepared = scanner.prepare(
                 ScanTarget(name=ref.name, artifact_id=ref.id,
@@ -361,6 +389,16 @@ class BatchScanRunner:
 
     def _scan_images(self, images: list,
                      options: Optional[ScanOptions] = None) -> list:
+        db, release = self._store_view()
+        try:
+            return self._scan_images_db(db, images, options)
+        finally:
+            if release is not None:
+                release()
+
+    def _scan_images_db(self, db, images: list,
+                        options: Optional[ScanOptions] = None) \
+            -> list:
         import time as _time
         options = options or ScanOptions(backend=self.backend)
         scan_secrets = "secret" in options.security_checks
@@ -439,8 +477,7 @@ class BatchScanRunner:
         # ---- phase 3: squash + advisory join (host) ----
         from ..obs.trace import phase_span
         t0 = _time.perf_counter()
-        scanner = LocalScanner(self.cache, self.store,
-                                   memo=self.memo)
+        scanner = LocalScanner(self.cache, db, memo=self.memo)
         prepared = []
         # the join span makes this host phase visible to the idle-
         # attribution timeline (host_pack_bound — the device waits
@@ -606,11 +643,22 @@ class BatchScanRunner:
 
         def analyze(req):
             from ..artifact.sbom import decode_to_blob
+            db, release = self._store_view()
+            if release is not None:
+                prev = req.on_done
+
+                def _done(r, _prev=prev, _rel=release):
+                    try:
+                        if _prev is not None:
+                            _prev(r)
+                    finally:
+                        _rel()
+                req.on_done = _done
             # a malformed document fails its own slot, never the
             # fleet (ValueError resolves this request only)
             atype, decoded, blob, blob_id = decode_to_blob(data)
             self.cache.put_blob(blob_id, blob)
-            scanner = LocalScanner(self.cache, self.store,
+            scanner = LocalScanner(self.cache, db,
                                    memo=self.memo)
             prepared = scanner.prepare(
                 ScanTarget(name=name, artifact_id=blob_id,
@@ -635,6 +683,15 @@ class BatchScanRunner:
 
     def _scan_boms(self, boms: list,
                    options: Optional[ScanOptions] = None) -> list:
+        db, release = self._store_view()
+        try:
+            return self._scan_boms_db(db, boms, options)
+        finally:
+            if release is not None:
+                release()
+
+    def _scan_boms_db(self, db, boms: list,
+                      options: Optional[ScanOptions] = None) -> list:
         import time as _time
 
         from ..artifact.sbom import decode_to_blob
@@ -653,8 +710,7 @@ class BatchScanRunner:
         # fails only its own slot.
         from .hostpool import map_in_pool
         t0 = _time.perf_counter()
-        scanner = LocalScanner(self.cache, self.store,
-                                   memo=self.memo)
+        scanner = LocalScanner(self.cache, db, memo=self.memo)
 
         def decode_one(item):
             name, data = item

@@ -284,4 +284,9 @@ class SchedMetrics:
         # delta re-match accounting — process-wide like the rest
         from ..memo.metrics import MEMO_METRICS
         out["memo"] = MEMO_METRICS.snapshot()
+        # watch/admission counters (docs/serving.md "Continuous
+        # scanning & admission control"): push-event dispositions,
+        # event lag, admission verdicts — process-wide singletons
+        from ..watch.metrics import WATCH_METRICS
+        out["watch"] = WATCH_METRICS.snapshot()
         return out

@@ -244,6 +244,13 @@ class ScanScheduler:
             self._cv.notify_all()
         return request
 
+    def in_flight(self) -> int:
+        """Admitted-but-unresolved requests. Open-loop submitters
+        (the watch loop's in-flight watermarks, docs/serving.md
+        "Continuous scanning") poll this instead of reaching into
+        the metrics object."""
+        return self.metrics.in_flight()
+
     def stats(self) -> dict:
         out = self.metrics.snapshot()
         out["config"] = {
