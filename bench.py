@@ -29,8 +29,15 @@ import io
 import json
 import tarfile
 import time
+import warnings
 
 import numpy as np
+
+# application-level filter (see ops/intervals.py): the donated
+# kernels always trigger XLA's "not usable" aliasing advisory —
+# expected; keep bench stderr readable
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 N_IMAGES = 512
 PARITY_IMAGES = 64         # cpu-ref arm runs on this prefix
@@ -421,14 +428,16 @@ def bench_images() -> dict:
         device_s = sec.get("device_s", 0.0) + \
             stats.get("interval_device_s", 0.0)
 
-        # dispatch-overhead gate (docs/performance.md): host-side
-        # interval packing must not regress past the recorded
-        # BENCH_r05 baseline (0.60s dispatch vs 0.30s device on this
-        # fleet → ratio 2.0). Skipped when the device phase is too
-        # small to measure a stable ratio.
+        # dispatch-overhead gate (docs/performance.md §8): with the
+        # async slot runtime the blocking dispatch wall (wave pack +
+        # enqueue + residual collect) must not exceed the device
+        # wall — the r05 synchronous ladder measured ≈ 2.0 here; the
+        # double-buffered ring is what buys the other half.
+        # Skipped when the device phase is too small to measure a
+        # stable ratio.
         import os
         ratio_cap = float(os.environ.get("DISPATCH_GATE_RATIO",
-                                         "2.0"))
+                                         "1.0"))
         idisp = stats.get("interval_dispatch_s", 0.0)
         idev = stats.get("interval_device_s", 0.0)
         if os.environ.get("DISPATCH_GATE", "on") != "off" \
@@ -448,6 +457,23 @@ def bench_images() -> dict:
                 f"idle attribution covers only " \
                 f"{timeline['coverage']:.1%} of device idle " \
                 f"(floor {cov_floor:.0%}): {timeline}"
+            # async-runtime burn-down gate (docs/performance.md §8):
+            # the idle causes the slot ring exists to kill —
+            # dispatch_gap + upload_serialized — must stay under 10%
+            # of attributed idle on this 512-image timeline arm (the
+            # r05 synchronous ladder put the dispatch path at ~2x
+            # the device wall)
+            tattr = timeline["attribution"]
+            share = (tattr["dispatch_gap"]
+                     + tattr["upload_serialized"]) \
+                / timeline["idle_s"]
+            share_cap = float(os.environ.get("ASYNC_IDLE_GATE",
+                                             "0.10"))
+            if os.environ.get("ASYNC_GATE", "on") != "off":
+                assert share < share_cap, \
+                    f"dispatch_gap+upload_serialized claim " \
+                    f"{share:.1%} of attributed idle " \
+                    f"(cap {share_cap:.0%}): {tattr}"
         table = runner.secret_scanner.table
         return {
             "images": len(paths),
@@ -480,6 +506,17 @@ def bench_images() -> dict:
                 "dfa_upload": table.device_stats(),
             },
             "findings": {"vulns": n_vulns, "secrets": n_secrets},
+            # async slot runtime (docs/performance.md §8): the
+            # overlap the ring bought on this fleet, and the
+            # dispatch/device ratio the gate above enforces
+            "async_rt": {
+                "dispatch_depth": stats.get("dispatch_depth", 1),
+                "interval_waves": stats.get("interval_waves", 0),
+                "dispatch_overlap_ratio": stats.get(
+                    "dispatch_overlap_ratio", 0.0),
+                "dispatch_device_ratio": round(idisp / idev, 3)
+                if idev > 0 else 0.0,
+            },
             "idle_attribution": timeline,
         }
 
@@ -816,6 +853,87 @@ def _sched_cfg(**kw):
     return SchedConfig(**base)
 
 
+MULTIHOST_SIM_IMAGES = 16
+
+
+def _multihost_sim_arm(tmp: str, paths: list) -> dict:
+    """Spawn 2 simulated hosts (trivy_tpu/parallel/simhost.py), each
+    scanning its LPT slice in its own process on the CPU backend;
+    gate shard-layout parity and findings byte-identity against an
+    in-process single-host scan."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    from trivy_tpu.parallel.multihost import HostTopology
+    from trivy_tpu.parallel.simhost import run_simhost
+
+    spec = {"paths": list(paths), "devices": 4, "dispatch_depth": 2,
+            "db_fixture": {"alpine 3.16": {
+                f"pkg{i}": {f"CVE-2022-{1000 + i}":
+                            {"FixedVersion": f"1.{i % 7}.2-r0"}}
+                for i in range(0, 40, 2)}},
+            "vulns": {f"CVE-2022-{1000 + i}": {"Severity": "HIGH"}
+                      for i in range(0, 40, 2)}}
+    t0 = time.perf_counter()
+    single = run_simhost(spec, HostTopology())
+    single_s = time.perf_counter() - t0
+
+    spec_path = os.path.join(tmp, "mh-spec.json")
+    with open(spec_path, "w", encoding="utf-8") as f:
+        _json.dump(spec, f)
+    # both hosts run CONCURRENTLY — that is the contract being
+    # simulated, and it halves the arm's spawn + jax-import wall
+    procs, outs, walls = [], [], []
+    t0 = time.perf_counter()
+    for pid in range(2):
+        out_path = os.path.join(tmp, f"mh-host{pid}.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TRIVY_TPU_NUM_PROCESSES="2",
+                   TRIVY_TPU_PROCESS_ID=str(pid),
+                   TRIVY_TPU_COORDINATOR="sim:0")
+        procs.append((out_path, subprocess.Popen(
+            [sys.executable, "-m", "trivy_tpu.parallel.simhost",
+             spec_path, out_path],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)))
+    for pid, (out_path, proc) in enumerate(procs):
+        _, err = proc.communicate(timeout=600)
+        walls.append(round(time.perf_counter() - t0, 2))
+        assert proc.returncode == 0, \
+            f"sim host {pid} failed: {err[-2000:]}"
+        with open(out_path, encoding="utf-8") as f:
+            outs.append(_json.load(f))
+
+    # gate 1: shard-layout parity across processes
+    assert outs[0]["assign"] == outs[1]["assign"], \
+        "simulated hosts disagree on the global shard layout"
+    owned = sorted(outs[0]["indices"] + outs[1]["indices"])
+    assert owned == list(range(len(paths))), \
+        f"layout dropped/duplicated items: {owned}"
+    # gate 2: byte-identical findings vs the single-host fleet
+    merged = {}
+    for o in outs:
+        for i, rep in zip(o["indices"], o["reports"]):
+            merged[i] = rep
+    assert [merged[i] for i in range(len(paths))] == \
+        single["reports"], \
+        "multi-host union diverges from the single-host scan"
+    return {
+        "images": len(paths),
+        "hosts": 2,
+        "assign": outs[0]["assign"],
+        "per_host_images": [len(o["indices"]) for o in outs],
+        "single_host_s": round(single_s, 2),
+        "per_host_wall_s": walls,     # dominated by process spawn +
+        # jax import on the CPU sim; the contract, not the speed,
+        # is what this arm gates
+        "layout_parity": True,
+        "byte_identical": True,
+    }
+
+
 def bench_mesh_scaling() -> dict:
     """Strong-scaling curve over a virtual CPU mesh: the SAME image
     fleet scanned with 1/2/4/8 mesh devices (sharded sieve + sharded
@@ -965,6 +1083,15 @@ def bench_mesh_scaling() -> dict:
         out["db_upload"] = cdb.device_stats()
         out["dfa_upload"] = SECRET_METRICS.snapshot()[
             "dfa_upload_amortization"]
+
+        # --- multi-process simulation arm (docs/performance.md §8
+        # "Multi-host mesh"): 2 spawned sim hosts over a fleet
+        # prefix, gating the pod contract CI can actually test —
+        # every host derives the IDENTICAL global LPT layout with no
+        # coordination traffic, and the union of per-host scans is
+        # byte-identical to a single-host scan of the same fleet.
+        out["multihost_sim"] = _multihost_sim_arm(
+            tmp, paths[:MULTIHOST_SIM_IMAGES])
 
     # --- the mesh gate ---
     # The virtual devices are only as parallel as the host has cores
@@ -1961,7 +2088,8 @@ def bench_timeline() -> dict:
             "findings diverged with the profiler running"
 
         t0 = time.perf_counter()
-        report = from_tracer(on_tracer).report(per_batch=True)
+        tl = from_tracer(on_tracer)
+        report = tl.report(per_batch=True)
         timeline_s = time.perf_counter() - t0
 
         cov_floor = float(os.environ.get("TIMELINE_COVERAGE",
@@ -1971,6 +2099,41 @@ def bench_timeline() -> dict:
                 f"idle attribution covers only " \
                 f"{report['coverage']:.1%} of device idle " \
                 f"(floor {cov_floor:.0%}): {report['attribution']}"
+
+        # async-runtime burn-down gate (docs/performance.md §8):
+        # dispatch_gap + upload_serialized are the idle causes the
+        # double-buffered slot ring exists to kill; their combined
+        # share of STEADY-STATE idle must stay under 10%. Steady
+        # state = from the first kernel onward: nothing exists to
+        # overlap the very first batch's staging, so the cold-start
+        # ramp would only add unfixable milliseconds to the
+        # numerator on a fleet the runtime already keeps >90% busy.
+        # A regression back to the r05 synchronous shape inflates
+        # steady idle itself, which is exactly what re-arms this.
+        busy = tl.busy_intervals()
+        steady = from_tracer(on_tracer, window=(busy[0][0], tl.t1))\
+            .report() if busy else report
+        sattr = steady["attribution"]
+        async_share = 0.0
+        if steady["idle_s"] > 0:
+            async_share = (sattr["dispatch_gap"]
+                           + sattr["upload_serialized"]) \
+                / steady["idle_s"]
+        # enforced only past half a second of steady idle: this
+        # 64-image arm keeps the device so busy that its residual
+        # idle is tens of milliseconds of scheduling dust, and a
+        # share over dust flakes (measured 9ms→87ms dispatch_gap
+        # across back-to-back runs). The 512-image images config
+        # enforces the same 10% cap on a meaningful denominator —
+        # THAT is the acceptance gate; this arm records the number
+        # and re-arms if idle ever grows back to r05 scale.
+        share_cap = float(os.environ.get("ASYNC_IDLE_GATE", "0.10"))
+        if steady["idle_s"] >= 0.5 and \
+                os.environ.get("ASYNC_GATE", "on") != "off":
+            assert async_share < share_cap, \
+                f"dispatch_gap+upload_serialized claim " \
+                f"{async_share:.1%} of steady-state idle " \
+                f"(cap {share_cap:.0%}): {sattr}"
 
         overhead = (prof.overhead_s + timeline_s) / off_s
         assert overhead < 0.02, \
@@ -1986,6 +2149,7 @@ def bench_timeline() -> dict:
             "obs_overhead": round(overhead, 6),
             "profiler": prof.stats(),
             "timeline_reconstruct_s": round(timeline_s, 4),
+            "async_idle_share": round(async_share, 4),
             "idle_attribution": report,
         }
 

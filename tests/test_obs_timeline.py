@@ -133,6 +133,99 @@ class TestAttribution:
         assert by_batch[None]["wait_s"] == pytest.approx(2.0)
 
 
+class TestOverlappedUploads:
+    """The async-runtime attribution rule: an upload span that ran
+    concurrently with device compute is PIPELINED — it must not be
+    charged as upload_serialized idle; only uploads that actually
+    serialize against an idle device count."""
+
+    def test_overlapped_upload_not_charged(self):
+        # upload [2,6] overlaps busy [0,4] → pipelined; its idle
+        # tail [4,6] must fall through (device window open →
+        # dispatch_gap), NOT count as upload_serialized
+        spans = [
+            FakeSpan("scan", 0.0, 8.0),
+            FakeSpan("device", 0.0, 8.0),
+            FakeSpan("device_compute", 0.0, 4.0),
+            FakeSpan("h2d_upload", 2.0, 6.0),
+        ]
+        attr = _check_partition(Timeline(spans))
+        assert attr["upload_serialized"] == 0.0
+        assert attr["dispatch_gap"] == pytest.approx(4.0)
+
+    def test_serialized_upload_still_charged(self):
+        # upload [4,6] touches no busy interval → it truly
+        # serialized; the covered idle is upload_serialized
+        spans = [
+            FakeSpan("scan", 0.0, 8.0),
+            FakeSpan("device_compute", 0.0, 4.0),
+            FakeSpan("h2d_upload", 4.5, 6.0),
+        ]
+        attr = _check_partition(Timeline(spans))
+        assert attr["upload_serialized"] == pytest.approx(1.5)
+
+    def test_slot_wait_cause(self):
+        # executor parked on a full ring [3,5] while the device sat
+        # idle → typed slot_wait, higher priority than dispatch_gap
+        spans = [
+            FakeSpan("scan", 0.0, 6.0),
+            FakeSpan("device", 0.0, 6.0),
+            FakeSpan("device_compute", 0.0, 3.0),
+            FakeSpan("slot_wait", 3.0, 5.0),
+        ]
+        attr = _check_partition(Timeline(spans))
+        assert attr["slot_wait"] == pytest.approx(2.0)
+        assert attr["dispatch_gap"] == pytest.approx(1.0)
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_partition_exact_with_overlapping_uploads(self, seed):
+        """Seeded span soups BIASED toward uploads overlapping
+        compute: the partition must stay exact (sum == idle) and
+        upload_serialized must equal an independent reference
+        computed from only the non-overlapping upload spans."""
+        rng = np.random.default_rng(4000 + seed)
+        spans = [FakeSpan("scan", 0.0, 60.0)]
+        busy = []
+        for _ in range(int(rng.integers(2, 10))):
+            s = float(rng.uniform(0, 50))
+            e = s + float(rng.uniform(0.5, 8))
+            busy.append((s, e))
+            spans.append(FakeSpan("device_compute", s, e))
+        uploads = []
+        for _ in range(int(rng.integers(2, 12))):
+            if rng.random() < 0.5 and busy:
+                # deliberately overlap a busy interval
+                b = busy[int(rng.integers(0, len(busy)))]
+                s = float(rng.uniform(b[0], b[1]))
+            else:
+                s = float(rng.uniform(0, 55))
+            e = s + float(rng.uniform(0.2, 6))
+            uploads.append((s, e))
+            spans.append(FakeSpan("h2d_upload", s, e))
+        tl = Timeline(spans)
+        attr = _check_partition(tl)
+
+        # reference: clip only never-overlapping uploads to idle
+        def olap(a, b):
+            return max(0.0, min(a[1], b[1]) - max(a[0], b[0]))
+
+        serial = [u for u in uploads
+                  if all(olap(u, b) <= 0.0 for b in busy)]
+        expect = 0.0
+        for lo, hi in tl.idle_intervals():
+            covered = []
+            for s, e in serial:
+                covered.append((max(s, lo), min(e, hi)))
+            covered = sorted((s, e) for s, e in covered if e > s)
+            cur = lo
+            for s, e in covered:
+                if e > cur:
+                    expect += e - max(s, cur)
+                    cur = max(cur, e)
+        assert attr["upload_serialized"] == pytest.approx(
+            expect, abs=1e-6)
+
+
 class TestPropertyRandomTrees:
     """Seeded random span soups: the partition invariants must hold
     for ANY input — no overlap, no negative gap, full coverage of

@@ -185,6 +185,23 @@ def build_parser() -> argparse.ArgumentParser:
                         help="admission queue bound (backpressure)")
         sp.add_argument("--sched-workers", type=int, default=4,
                         help="host worker pool size")
+        sp.add_argument("--dispatch-depth", type=int, default=0,
+                        help="device slots in flight (async "
+                        "double-buffered runtime, "
+                        "docs/performance.md §8): 2 uploads batch "
+                        "N+1 while N computes, 1 restores the "
+                        "synchronous ladder; 0 = "
+                        "TRIVY_TPU_DISPATCH_DEPTH or 2")
+        sp.add_argument("--coordinator", default="",
+                        help="multi-host pod: host:port of process "
+                        "0 (TRIVY_TPU_COORDINATOR); requires "
+                        "--num-processes/--process-id")
+        sp.add_argument("--num-processes", type=int, default=0,
+                        help="multi-host pod: total scanner "
+                        "processes (TRIVY_TPU_NUM_PROCESSES)")
+        sp.add_argument("--process-id", type=int, default=-1,
+                        help="multi-host pod: this process's id "
+                        "(TRIVY_TPU_PROCESS_ID)")
         sp.add_argument("--tenant-config", default="",
                         help="multi-tenant QoS table "
                         "(docs/serving.md): a JSON file path or an "
@@ -424,6 +441,17 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--sched-flush-ms", type=float, default=50.0)
     srv.add_argument("--sched-queue", type=int, default=256)
     srv.add_argument("--sched-workers", type=int, default=4)
+    srv.add_argument("--dispatch-depth", type=int, default=0,
+                     help="device slots in flight "
+                     "(docs/performance.md §8); 0 = "
+                     "TRIVY_TPU_DISPATCH_DEPTH or 2")
+    srv.add_argument("--coordinator", default="",
+                     help="multi-host pod: host:port of process 0 "
+                     "(TRIVY_TPU_COORDINATOR)")
+    srv.add_argument("--num-processes", type=int, default=0,
+                     help="multi-host pod: total scanner processes")
+    srv.add_argument("--process-id", type=int, default=-1,
+                     help="multi-host pod: this process's id")
     srv.add_argument("--tenant-config", default="",
                      help="multi-tenant QoS table (docs/serving.md "
                      "'Multi-tenant QoS'): JSON file or inline "
@@ -515,6 +543,14 @@ _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
 def main(argv=None) -> int:
     from .flag import (ScanTimeout, apply_external_defaults,
                        parse_duration, scan_deadline)
+    # application-level filter: the donated kernels trigger XLA's
+    # "not usable" aliasing advisory on every compile (bool/uint16
+    # outputs can never alias their int32/uint8 payload inputs —
+    # expected, see ops/intervals.py); silence it for CLI runs only,
+    # never in the library, so embedders keep the signal
+    import warnings as _warnings
+    _warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")
     raw_argv = list(sys.argv[1:] if argv is None else argv)
     # unknown subcommands dispatch to installed plugins (app.go:96)
     if raw_argv and not raw_argv[0].startswith("-") and \
@@ -877,6 +913,9 @@ def run_server(args) -> int:
         except ValueError as e:
             print(f"error: --slo-config: {e}", file=sys.stderr)
             return 2
+    rc = _init_multihost(args)
+    if rc:
+        return rc
     sched = "off"
     scheduler = None
     if getattr(args, "sched", "on") == "on":
@@ -958,6 +997,10 @@ def _admission_controller(args, server) -> tuple:
         backend=getattr(args, "backend", "tpu"),
         sched=(server.scheduler if server.scheduler is not None
                else "on"),
+        # honored when this runner builds its own scheduler (the
+        # --sched off server case); a shared scheduler already
+        # carries the flag via _sched_config
+        dispatch_depth=getattr(args, "dispatch_depth", 0) or 0,
         memo=server.memo)
     controller = AdmissionController(
         runner, store=server.store, memo=server.memo,
@@ -1597,6 +1640,7 @@ def _reject_unwired_fault_spec(args) -> bool:
 
 
 def _sched_config(args):
+    from .runtime.ring import resolve_dispatch_depth
     from .sched import SchedConfig, parse_tenant_config
     tenancy = None
     if getattr(args, "tenant_config", ""):
@@ -1609,7 +1653,34 @@ def _sched_config(args):
         workers=getattr(args, "sched_workers", 4),
         flush_timeout_s=getattr(args, "sched_flush_ms", 50.0)
         / 1000.0,
+        dispatch_depth=resolve_dispatch_depth(
+            getattr(args, "dispatch_depth", 0) or 0),
         tenancy=tenancy)
+
+
+def _init_multihost(args) -> int:
+    """Join the pod when ``--coordinator``/``--num-processes``/
+    ``--process-id`` or the TRIVY_TPU_* env describe one (the
+    jax.distributed seam, docs/performance.md §8). Returns 0, or 2
+    on a malformed topology. Must run before any jax backend touch
+    so jax.devices() becomes the global set."""
+    from .parallel.multihost import initialize, topology_from_env
+    try:
+        topo = topology_from_env(
+            coordinator=getattr(args, "coordinator", ""),
+            num_processes=getattr(args, "num_processes", 0) or 0,
+            process_id=(getattr(args, "process_id", -1)
+                        if getattr(args, "process_id", -1)
+                        is not None else -1))
+        if topo.multi_host:
+            initialize(topo)
+            print(f"multi-host: process {topo.process_id}/"
+                  f"{topo.num_processes} joined via "
+                  f"{topo.coordinator}", file=sys.stderr)
+    except (ValueError, RuntimeError) as e:
+        print(f"error: multi-host topology: {e}", file=sys.stderr)
+        return 2
+    return 0
 
 
 def _run_image_batch(args, targets: list) -> int:
@@ -1658,6 +1729,9 @@ def _run_image_batch(args, targets: list) -> int:
     except ValueError as e:
         print(f"error: --tenant-config: {e}", file=sys.stderr)
         return 2
+    rc = _init_multihost(args)
+    if rc:
+        return rc
     runner = BatchScanRunner(
         store=store, cache=cache, backend=backend,
         secret_scanner=opt.secret_scanner,
@@ -1665,6 +1739,7 @@ def _run_image_batch(args, targets: list) -> int:
         sched_config=sched_config,
         artifact_option=opt,
         fault_injector=injector,
+        dispatch_depth=getattr(args, "dispatch_depth", 0) or 0,
         memo=_memo(args, cache, option=opt, injector=injector)
         if "vuln" in checks else None)
     options = _scan_options(args)

@@ -16,6 +16,22 @@ duplicate's payload. Row tables are packed with bulk fancy-index
 stores into PREALLOCATED buffers padded to a small bucket ladder, so
 XLA's compile cache is keyed by a handful of shapes instead of one
 per arbitrary batch size.
+
+Two dispatch surfaces share those mechanics:
+
+* :func:`dispatch_jobs` — the synchronous ladder (pack → upload →
+  compute → collect on the calling thread); cpu-ref, host fallback
+  and the quarantine path stay here.
+* :func:`dispatch_jobs_async` / :func:`collect_dispatch` — the
+  double-buffered slot runtime (docs/performance.md "Async device
+  runtime"): rows split into bounded waves, each wave's payload
+  buffers uploaded fresh and DONATED to the jitted kernel
+  (``interval_hits_donated`` — resident advisory tables are never
+  donated), the kernel enqueued non-blocking, and the blocking
+  materialize pushed to a :class:`runtime.ring.DispatchRing` drain
+  thread so wave N+1 packs while wave N computes. Results are
+  byte-identical to the synchronous ladder at every wave split,
+  dispatch depth, and device count (property-tested).
 """
 
 from __future__ import annotations
@@ -129,15 +145,12 @@ def _dedup(jobs: list, key_fn) -> tuple:
     return reps, members
 
 
-def detect_pairs(jobs: list, backend: str = "tpu",
-                 mesh=None, stats: Optional[dict] = None) -> list:
-    """Returns payloads of vulnerable pairs, batch order preserved.
-    With ``mesh``, pair rows shard over every chip (see
-    parallel.interval_shard)."""
-    if not jobs:
-        return []
-    from ..obs.trace import phase_span
-    sink = stats if stats is not None else last_dispatch_stats
+def _prep_classic(jobs: list, sink: dict) -> tuple:
+    """Dedup + per-grammar compile shared by the sync and async
+    dispatch paths: ``(reps, members, spaces, rows, host_groups)``
+    where ``rows`` holds the kernel-path representatives in group
+    order. Rank spaces are NOT finalized yet (wave packing must see
+    every interned constraint bound first)."""
     reps, members = _dedup(jobs, PairJob.dedup_key)
     sink["jobs_in"] = sink.get("jobs_in", 0) + len(jobs)
     sink["jobs_unique"] = sink.get("jobs_unique", 0) + len(reps)
@@ -164,6 +177,63 @@ def detect_pairs(jobs: list, backend: str = "tpu",
         if flags is None:
             continue                      # statically not vulnerable
         rows.append((gi, job, pkg_key, vuln_ivs, sec_ivs, flags))
+    return reps, members, spaces, rows, host_groups
+
+
+def _pack_classic(rows: list, spaces: dict, Pp: int) -> tuple:
+    """Pack a row slice into padded [Pp] / [Pp, M] tables (pad rows
+    inert: flags=0). One fancy-index store per table, as before —
+    a wave packs exactly like the monolithic table did, so a hit is
+    position-independent and the wave split cannot change results."""
+    pkg_rank = np.zeros(Pp, np.int32)
+    v_lo = np.full((Pp, MAX_INTERVALS), POS_INF, np.int32)
+    v_hi = np.full((Pp, MAX_INTERVALS), NEG_INF, np.int32)
+    s_lo = np.full((Pp, MAX_INTERVALS), POS_INF, np.int32)
+    s_hi = np.full((Pp, MAX_INTERVALS), NEG_INF, np.int32)
+    flags_arr = np.zeros(Pp, np.int32)
+    # encode per row, store with ONE fancy-index write per
+    # table instead of one scalar store per interval slot
+    vi: list = []
+    vj: list = []
+    vb: list = []
+    si: list = []
+    sj: list = []
+    sb: list = []
+    for i, (gi, job, pkg_key, vuln_ivs, sec_ivs, flags) in \
+            enumerate(rows):
+        sp = spaces[job.grammar]
+        pkg_rank[i] = sp.rank(pkg_key)
+        flags_arr[i] = flags
+        for j, iv in enumerate(vuln_ivs):
+            vi.append(i)
+            vj.append(j)
+            vb.append(sp.encode(iv))
+        for j, iv in enumerate(sec_ivs):
+            si.append(i)
+            sj.append(j)
+            sb.append(sp.encode(iv))
+    if vb:
+        b = np.asarray(vb, np.int32)
+        v_lo[vi, vj] = b[:, 0]
+        v_hi[vi, vj] = b[:, 1]
+    if sb:
+        b = np.asarray(sb, np.int32)
+        s_lo[si, sj] = b[:, 0]
+        s_hi[si, sj] = b[:, 1]
+    return pkg_rank, v_lo, v_hi, s_lo, s_hi, flags_arr
+
+
+def detect_pairs(jobs: list, backend: str = "tpu",
+                 mesh=None, stats: Optional[dict] = None) -> list:
+    """Returns payloads of vulnerable pairs, batch order preserved.
+    With ``mesh``, pair rows shard over every chip (see
+    parallel.interval_shard)."""
+    if not jobs:
+        return []
+    from ..obs.trace import phase_span
+    sink = stats if stats is not None else last_dispatch_stats
+    reps, members, spaces, rows, host_groups = \
+        _prep_classic(jobs, sink)
 
     hit_jobs: list = []          # original job indices that hit
     if rows:
@@ -172,41 +242,8 @@ def detect_pairs(jobs: list, backend: str = "tpu",
                 sp.finalize()
             P = len(rows)
             Pp = P if backend == "cpu-ref" else _job_bucket(P)
-            pkg_rank = np.zeros(Pp, np.int32)
-            v_lo = np.full((Pp, MAX_INTERVALS), POS_INF, np.int32)
-            v_hi = np.full((Pp, MAX_INTERVALS), NEG_INF, np.int32)
-            s_lo = np.full((Pp, MAX_INTERVALS), POS_INF, np.int32)
-            s_hi = np.full((Pp, MAX_INTERVALS), NEG_INF, np.int32)
-            flags_arr = np.zeros(Pp, np.int32)
-            # encode per row, store with ONE fancy-index write per
-            # table instead of one scalar store per interval slot
-            vi: list = []
-            vj: list = []
-            vb: list = []
-            si: list = []
-            sj: list = []
-            sb: list = []
-            for i, (gi, job, pkg_key, vuln_ivs, sec_ivs, flags) in \
-                    enumerate(rows):
-                sp = spaces[job.grammar]
-                pkg_rank[i] = sp.rank(pkg_key)
-                flags_arr[i] = flags
-                for j, iv in enumerate(vuln_ivs):
-                    vi.append(i)
-                    vj.append(j)
-                    vb.append(sp.encode(iv))
-                for j, iv in enumerate(sec_ivs):
-                    si.append(i)
-                    sj.append(j)
-                    sb.append(sp.encode(iv))
-            if vb:
-                b = np.asarray(vb, np.int32)
-                v_lo[vi, vj] = b[:, 0]
-                v_hi[vi, vj] = b[:, 1]
-            if sb:
-                b = np.asarray(sb, np.int32)
-                s_lo[si, sj] = b[:, 0]
-                s_hi[si, sj] = b[:, 1]
+            (pkg_rank, v_lo, v_hi, s_lo, s_hi,
+             flags_arr) = _pack_classic(rows, spaces, Pp)
         import time as _time
         t0 = _time.perf_counter()
         # device_compute brackets the kernel execution alone — it is
@@ -250,6 +287,7 @@ def detect_pairs(jobs: list, backend: str = "tpu",
 def _device_hits(*arrs):
     import jax
     from ..obs.trace import phase_span
+    from ..ops.intervals import interval_hits_donated
     with phase_span("h2d_upload",
                     bytes=int(sum(a.nbytes for a in arrs))):
         dev = [jax.device_put(a) for a in arrs]
@@ -258,8 +296,11 @@ def _device_hits(*arrs):
         # materialize INSIDE the span: interval_hits is jitted
         # (async dispatch), so returning the lazy array would close
         # the span after enqueue microseconds and the timeline would
-        # misattribute the real kernel wall to dispatch_gap
-        return np.asarray(interval_hits(*dev))
+        # misattribute the real kernel wall to dispatch_gap.
+        # Every operand is a fresh per-dispatch upload, so the
+        # donated variant lets the kernel reuse the payload HBM
+        # (buffer-donation audit, docs/performance.md §8)
+        return np.asarray(interval_hits_donated(*dev))
 
 
 class _HostFallback(Exception):
@@ -360,6 +401,38 @@ class ResidentPairJob:
                 self.report_unfixed)
 
 
+def _prep_resident(jobs: list, cdb, sink: dict) -> tuple:
+    """Dedup + row triage shared by the sync and async resident
+    paths: ``(reps, members, kept, ranks, rows, host)``."""
+    from ..db.compiled import F_HOST, F_UNFIXED
+    reps, members = _dedup(jobs, ResidentPairJob.dedup_key)
+    sink["jobs_in"] = sink.get("jobs_in", 0) + len(jobs)
+    sink["jobs_unique"] = sink.get("jobs_unique", 0) + len(reps)
+    DETECT_METRICS.note_dispatch(len(jobs), len(reps))
+
+    kept: list = []              # group indices on the kernel path
+    ranks: list = []
+    rows: list = []
+    host: list = []              # group indices on the host path
+    for gi, job in enumerate(reps):
+        flags = int(cdb.flags[job.row])
+        if (flags & F_UNFIXED) and not job.report_unfixed:
+            continue
+        comparer = get_comparer(job.grammar)
+        if (flags & F_HOST) or getattr(
+                comparer, "is_prerelease",
+                lambda v: False)(job.pkg_version):
+            host.append(gi)
+            continue
+        r = cdb.pkg_rank(job.grammar, job.pkg_version)
+        if r is None:
+            continue                 # version parse error: skip
+        kept.append(gi)
+        ranks.append(r)
+        rows.append(job.row)
+    return reps, members, kept, ranks, rows, host
+
+
 def detect_pairs_resident(jobs: list, backend: str = "tpu",
                           mesh=None,
                           stats: Optional[dict] = None) -> list:
@@ -371,7 +444,6 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
         return []
     from ..obs.trace import phase_span
     sink = stats if stats is not None else last_dispatch_stats
-    from ..db.compiled import F_HOST, F_UNFIXED
 
     cdb = jobs[0].cdb
     if any(j.cdb is not cdb for j in jobs):
@@ -386,32 +458,10 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
             out.extend(detect_pairs_resident(
                 js, backend=backend, mesh=mesh, stats=stats))
         return out
-    reps, members = _dedup(jobs, ResidentPairJob.dedup_key)
-    sink["jobs_in"] = sink.get("jobs_in", 0) + len(jobs)
-    sink["jobs_unique"] = sink.get("jobs_unique", 0) + len(reps)
-    DETECT_METRICS.note_dispatch(len(jobs), len(reps))
-
-    kept: list = []              # group indices on the kernel path
-    ranks: list = []
-    rows: list = []
-    host: list = []              # group indices on the host path
-    with phase_span("pack", jobs=len(jobs), unique=len(reps)):
-        for gi, job in enumerate(reps):
-            flags = int(cdb.flags[job.row])
-            if (flags & F_UNFIXED) and not job.report_unfixed:
-                continue
-            comparer = get_comparer(job.grammar)
-            if (flags & F_HOST) or getattr(
-                    comparer, "is_prerelease",
-                    lambda v: False)(job.pkg_version):
-                host.append(gi)
-                continue
-            r = cdb.pkg_rank(job.grammar, job.pkg_version)
-            if r is None:
-                continue                 # version parse error: skip
-            kept.append(gi)
-            ranks.append(r)
-            rows.append(job.row)
+    with phase_span("pack", jobs=len(jobs)) as psp:
+        reps, members, kept, ranks, rows, host = \
+            _prep_resident(jobs, cdb, sink)
+        psp.set("unique", len(reps))
 
     hit_jobs: list = []
     if kept:
@@ -442,7 +492,8 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
                     mesh, pkg_rank, row_idx, tables)
         else:
             import jax
-            from ..ops.intervals import interval_hits_resident
+            from ..ops.intervals import \
+                interval_hits_resident_donated
             tables = cdb.device_tables()
             with phase_span("h2d_upload",
                             bytes=int(pkg_rank.nbytes +
@@ -451,7 +502,10 @@ def detect_pairs_resident(jobs: list, backend: str = "tpu",
                 di = jax.device_put(row_idx)
             with phase_span("device_compute", kind="interval",
                             rows=P):
-                hits = np.asarray(interval_hits_resident(
+                # dr/di are fresh per-dispatch uploads → donated;
+                # the resident tables are shared across every
+                # dispatch of this generation → never donated
+                hits = np.asarray(interval_hits_resident_donated(
                     dr, di, *tables))
         sink["device_s"] = sink.get("device_s", 0.0) + \
             _time.perf_counter() - t0
@@ -493,4 +547,306 @@ def dispatch_jobs(jobs: list, backend: str = "tpu",
     for js in by_db.values():
         out.extend(detect_pairs_resident(js, backend=backend,
                                          mesh=mesh, stats=sink))
+    return out
+
+
+# ---- async slot dispatch (docs/performance.md §8) ----
+#
+# dispatch_jobs_async() splits the kernel rows into bounded WAVES,
+# enqueues every wave non-blocking (payload buffers device_put fresh
+# per wave and DONATED to the kernel), and defers the blocking
+# materialize to collect_dispatch() — or, when a DispatchRing is
+# passed, to the ring's drain thread, which blocks on wave N while
+# the submitting thread packs and uploads wave N+1. The drain
+# thread's wait is where the device wall actually passes, so its
+# device_compute spans carry the true kernel wall for the
+# idle-attribution timeline.
+
+_WAVE_ROWS = 4096      # max kernel rows launched per wave
+
+
+def _activate_ctx(span):
+    from ..obs.trace import activate_or_null
+    return activate_or_null(span)
+
+
+class _EagerSegment:
+    """Backend with no async device path (cpu-ref): the synchronous
+    ladder already ran at dispatch; collect replays its output."""
+
+    def __init__(self, out: list):
+        self.out = out
+
+    def collect(self) -> list:
+        return self.out
+
+
+class _WaveSegment:
+    """Shared wave bookkeeping for the classic and resident async
+    paths: launch waves, collect them FIFO, fan hits back out
+    through the dedup members exactly like the synchronous path."""
+
+    def __init__(self, jobs: list, sink: dict, ring):
+        from ..obs.trace import current_span
+        self.jobs = jobs
+        self.sink = sink
+        self.ring = ring
+        # phase spans from ring/pool threads parent under whatever
+        # span was active at launch (the batch's device span)
+        self.ctx_span = current_span()
+        self.waves: list = []
+        self.members: list = []
+        self.reps: list = []
+
+    def _launch_wave(self, k: int, build) -> None:
+        """``build()`` does the upload + non-blocking enqueue and
+        returns the wave dict. With a ring it runs as the submit's
+        ``launch`` callable, AFTER capacity is acquired — so a full
+        ring parks before wave k+1 stages any HBM (the depth bound
+        covers staged buffers, not just bookkeeping)."""
+        if self.ring is not None:
+            built: dict = {}
+
+            def _launch():
+                built["wave"] = build()
+                return built["wave"]
+
+            slot = self.ring.submit(self._collect_wave,
+                                    launch=_launch,
+                                    label=f"interval:w{k}")
+            wave = built["wave"]
+            wave["slot"] = slot
+        else:
+            wave = build()
+        self.waves.append(wave)
+
+    def _collect_wave(self, wave: dict):
+        import time as _time
+        from ..obs.trace import phase_span
+        t0 = _time.perf_counter()
+        with _activate_ctx(self.ctx_span):
+            with phase_span("device_compute", kind="interval",
+                            rows=wave["rows"]):
+                # materializing blocks until the enqueued kernel
+                # finished — on the drain thread this runs
+                # concurrently with the next wave's pack/upload,
+                # and the span brackets the real device wall
+                hits = np.asarray(wave["lazy"])
+        wave["hits"] = hits
+        wave["lazy"] = None          # free the donated output early
+        self.sink["device_s"] = self.sink.get("device_s", 0.0) + \
+            _time.perf_counter() - t0
+
+    def _kernel_hits(self) -> list:
+        hit_jobs: list = []
+        for wave in self.waves:
+            slot = wave.get("slot")
+            if slot is not None:
+                slot.wait()
+            elif "hits" not in wave:
+                self._collect_wave(wave)
+            for i in np.nonzero(wave["hits"][:wave["rows"]])[0]:
+                hit_jobs.extend(self.members[wave["groups"][i]])
+        return hit_jobs
+
+    def _host_hits(self, host_groups: list, eval_fn) -> list:
+        host_hits: list = []
+        for gi in host_groups:
+            if eval_fn(self.reps[gi]):
+                host_hits.extend(self.members[gi])
+        return host_hits
+
+
+class _ClassicSegment(_WaveSegment):
+    def __init__(self, jobs: list, mesh, sink: dict, ring,
+                 max_wave_rows: int):
+        super().__init__(jobs, sink, ring)
+        import jax
+        from ..obs.trace import phase_span
+        with phase_span("pack", jobs=len(jobs)) as psp:
+            (self.reps, self.members, spaces, rows,
+             self.host_groups) = _prep_classic(jobs, sink)
+            for sp in spaces.values():
+                sp.finalize()
+            psp.set("unique", len(self.reps))
+        if not rows:
+            return
+        w = max(1, int(max_wave_rows))
+        slices = [rows[a:a + w] for a in range(0, len(rows), w)]
+
+        def _pack(sl):
+            Pp = _job_bucket(len(sl))
+            with _activate_ctx(self.ctx_span):
+                with phase_span("pack", rows=len(sl)):
+                    return _pack_classic(sl, spaces, Pp)
+
+        # pool-parallel wave packing: the fancy-index fills of every
+        # wave run on the hostpool while this thread uploads and
+        # enqueues the waves in order (runtime/hostpool.py — pack is
+        # pure compute, never blocks on scheduler events)
+        futs = None
+        if len(slices) > 1:
+            from ..runtime.hostpool import get_host_pool
+            import threading as _threading
+            if not _threading.current_thread().name.startswith(
+                    "trivy-hostpool"):
+                pool = get_host_pool()
+                if pool is not None:
+                    futs = [pool.submit(_pack, sl) for sl in slices]
+        for k, sl in enumerate(slices):
+
+            def build(k=k, sl=sl):
+                arrays = futs[k].result() if futs is not None \
+                    else _pack(sl)
+                if mesh is not None:
+                    from ..parallel.interval_shard import \
+                        sharded_interval_hits_async
+                    lazy = sharded_interval_hits_async(mesh,
+                                                       *arrays)
+                else:
+                    from ..ops.intervals import \
+                        interval_hits_donated
+                    with phase_span("h2d_upload", bytes=int(
+                            sum(a.nbytes for a in arrays))):
+                        dev = [jax.device_put(a) for a in arrays]
+                    # dev buffers are this wave's alone → donated;
+                    # the kernel reuses the slot HBM for its output
+                    lazy = interval_hits_donated(*dev)
+                return {"lazy": lazy, "rows": len(sl),
+                        "groups": [r[0] for r in sl]}
+
+            self._launch_wave(k, build)
+
+    def collect(self) -> list:
+        hit_jobs = self._kernel_hits()
+        out = [self.jobs[i].payload for i in sorted(hit_jobs)]
+        host_hits = self._host_hits(self.host_groups, _host_eval)
+        out.extend(self.jobs[i].payload
+                   for i in sorted(host_hits))
+        return out
+
+
+class _ResidentSegment(_WaveSegment):
+    def __init__(self, jobs: list, cdb, mesh, sink: dict, ring,
+                 max_wave_rows: int):
+        super().__init__(jobs, sink, ring)
+        import jax
+        from ..obs.trace import phase_span
+        self.cdb = cdb
+        with phase_span("pack", jobs=len(jobs)) as psp:
+            (self.reps, self.members, kept, ranks, rows,
+             self.host_groups) = _prep_resident(jobs, cdb, sink)
+            psp.set("unique", len(self.reps))
+        if not kept:
+            return
+        w = max(1, int(max_wave_rows))
+        tables = cdb.device_tables(mesh=mesh) if mesh is not None \
+            else cdb.device_tables()
+        for k, a in enumerate(range(0, len(kept), w)):
+
+            def build(a=a):
+                sl_kept = kept[a:a + w]
+                P = len(sl_kept)
+                Pp = _job_bucket(P)
+                pkg_rank = np.zeros(Pp, np.int32)
+                row_idx = np.zeros(Pp, np.int32)
+                pkg_rank[:P] = ranks[a:a + w]
+                row_idx[:P] = rows[a:a + w]
+                if mesh is not None:
+                    from ..parallel.interval_shard import \
+                        sharded_interval_hits_resident_async
+                    lazy = sharded_interval_hits_resident_async(
+                        mesh, pkg_rank, row_idx, tables)
+                else:
+                    from ..ops.intervals import \
+                        interval_hits_resident_donated
+                    with phase_span("h2d_upload", bytes=int(
+                            pkg_rank.nbytes + row_idx.nbytes)):
+                        dr = jax.device_put(pkg_rank)
+                        di = jax.device_put(row_idx)
+                    # gather operands donated; the resident
+                    # advisory tables are shared state and NEVER
+                    # donated
+                    lazy = interval_hits_resident_donated(
+                        dr, di, *tables)
+                return {"lazy": lazy, "rows": P,
+                        "groups": sl_kept}
+
+            self._launch_wave(k, build)
+
+    def collect(self) -> list:
+        hit_jobs = self._kernel_hits()
+        out = [self.jobs[i].payload for i in sorted(hit_jobs)]
+        host_hits = self._host_hits(
+            self.host_groups,
+            lambda job: job.cdb.host_eval(job.row,
+                                          job.pkg_version))
+        out.extend(self.jobs[i].payload
+                   for i in sorted(host_hits))
+        return out
+
+
+class IntervalDispatch:
+    """Handle returned by :func:`dispatch_jobs_async`; pass it to
+    :func:`collect_dispatch` (exactly once) to fetch results."""
+
+    def __init__(self, sink: dict):
+        self.sink = sink
+        self.segments: list = []
+
+    @property
+    def waves(self) -> int:
+        # eager (cpu-ref) segments count as one synchronous
+        # dispatch; wave segments count their actual launches — a
+        # segment whose jobs all host-fell-back launched ZERO waves
+        # and must report zero
+        return sum(len(s.waves) if hasattr(s, "waves") else 1
+                   for s in self.segments)
+
+
+def dispatch_jobs_async(jobs: list, backend: str = "tpu",
+                        mesh=None, stats: Optional[dict] = None,
+                        ring=None,
+                        max_wave_rows: int = _WAVE_ROWS) \
+        -> IntervalDispatch:
+    """Async half of :func:`dispatch_jobs`: dedup + compile + pack,
+    then enqueue every wave without materializing. ``ring`` (a
+    runtime.ring.DispatchRing) bounds in-flight waves and collects
+    them on its drain thread; without one the waves collect lazily
+    inside :func:`collect_dispatch` on the calling thread. Output
+    (via collect_dispatch) is byte-identical to dispatch_jobs for
+    any wave size, ring depth, and device count."""
+    sink = stats if stats is not None else last_dispatch_stats
+    sink["device_s"] = 0.0
+    sink["jobs_in"] = 0
+    sink["jobs_unique"] = 0
+    handle = IntervalDispatch(sink)
+    if backend == "cpu-ref":
+        # the exact host reference engine has no device work to
+        # overlap — run the synchronous ladder now (the differential
+        # baseline stays the differential baseline)
+        handle.segments.append(_EagerSegment(dispatch_jobs(
+            jobs, backend=backend, mesh=mesh, stats=sink)))
+        return handle
+    plain = [j for j in jobs if isinstance(j, PairJob)]
+    resident = [j for j in jobs if isinstance(j, ResidentPairJob)]
+    if plain:
+        handle.segments.append(_ClassicSegment(
+            plain, mesh, sink, ring, max_wave_rows))
+    by_db = {}
+    for j in resident:
+        by_db.setdefault(id(j.cdb), []).append(j)
+    for js in by_db.values():
+        handle.segments.append(_ResidentSegment(
+            js, js[0].cdb, mesh, sink, ring, max_wave_rows))
+    return handle
+
+
+def collect_dispatch(handle: IntervalDispatch) -> list:
+    """Blocking half: wait for every wave (FIFO), fan hits out to
+    the duplicate payloads, evaluate host-fallback pairs — same
+    output, same order, as the synchronous dispatcher."""
+    out: list = []
+    for seg in handle.segments:
+        out.extend(seg.collect())
     return out

@@ -179,6 +179,36 @@ def render_prometheus(stats: dict, phase_hists=None,
     w.scalar(f"{_PREFIX}_uptime_seconds", "gauge",
              "Scheduler uptime.", stats.get("uptime_s"))
 
+    dispatch = stats.get("dispatch") or {}
+    if dispatch:
+        # async slot runtime (docs/performance.md §8): the overlap
+        # the double-buffered ring buys, observable in prod
+        ring_counters = dispatch.get("counters") or {}
+        name = f"{_PREFIX}_dispatch_slots_total"
+        w.header(name, "counter",
+                 "Dispatch-ring slot lifecycle events by kind.")
+        for k in sorted(ring_counters):
+            w.sample(name, [("event", k)], ring_counters[k])
+        w.scalar(f"{_PREFIX}_dispatch_depth", "gauge",
+                 "Device slots currently in flight "
+                 "(launched, not yet collected).",
+                 dispatch.get("depth"))
+        w.scalar(f"{_PREFIX}_dispatch_depth_max", "gauge",
+                 "High-water in-flight slot count.",
+                 dispatch.get("depth_max"))
+        w.scalar(f"{_PREFIX}_slot_occupancy", "gauge",
+                 "Time-weighted mean in-flight slots over the "
+                 "configured ring depth.",
+                 dispatch.get("slot_occupancy"))
+        w.scalar(f"{_PREFIX}_dispatch_overlap_ratio", "gauge",
+                 "Share of slot-active wall with >= 2 slots in "
+                 "flight (0 = serial ladder).",
+                 dispatch.get("dispatch_overlap_ratio"))
+        w.scalar(f"{_PREFIX}_dispatch_slot_wait_seconds_total",
+                 "counter",
+                 "Wall spent parked on a full dispatch ring.",
+                 dispatch.get("slot_wait_s"))
+
     guard = stats.get("guard") or {}
     if guard:
         name = f"{_PREFIX}_guard_events_total"

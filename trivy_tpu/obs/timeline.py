@@ -14,14 +14,24 @@ cause               the device was idle because ...
 ==================  =================================================
 ``upload_serialized``  a host→device table/segment upload ran
                        (``h2d_upload`` / ``db_upload`` /
-                       ``dfa_upload`` spans) — uploads serialize with
-                       compute instead of double-buffering
+                       ``dfa_upload`` spans) AND that upload span
+                       never overlapped device compute — it truly
+                       serialized against an idle device. An upload
+                       that ran concurrently with a busy span is a
+                       PIPELINED upload (the async runtime's
+                       double-buffered staging) and is excluded
+                       from this cause entirely: the idle it covers
+                       falls through to the next matching cause
 ``host_pack_bound``    the host was producing the next batch
                        (``pack`` / ``analyze`` / ``join`` /
                        ``memo_lookup`` / ``delta_rematch`` spans)
 ``collect_bound``      the host was consuming the previous batch
                        (``decode`` / ``report`` / ``finish`` /
                        ``memo_store`` spans)
+``slot_wait``          the dispatch ring was full — the executor
+                       parked waiting for the drain thread to free
+                       a slot (runtime/ring.py); the pipeline is
+                       collection-gated, not work-starved
 ``dispatch_gap``       work was admitted — an open dispatch window
                        (``device`` span) or queued work
                        (``queue_wait`` / ``coalesce``) — but no
@@ -71,9 +81,18 @@ CAUSE_SPANS = (
                                    "delta_rematch"})),
     ("collect_bound", frozenset({"decode", "verify", "report",
                                  "finish", "memo_store"})),
+    # ring-full stalls of the async slot runtime (runtime/ring.py):
+    # below collect_bound (a full ring usually IS the collect side
+    # running behind) but above the catch-all dispatch_gap
+    ("slot_wait", frozenset({"slot_wait"})),
     ("dispatch_gap", frozenset({"device", "queue_wait",
                                 "coalesce"})),
 )
+
+# upload spans get the overlapped-upload treatment (see the table
+# above): only spans in this set that never ran concurrently with a
+# busy interval count toward upload_serialized
+_UPLOAD_SPANS = CAUSE_SPANS[0][1]
 
 # any open root ("scan") span means the scanner had work somewhere;
 # idle not explained above becomes unknown instead of queue_empty
@@ -154,8 +173,12 @@ class Timeline:
         self._busy = _merge([iv for n in DEVICE_BUSY
                              for iv in by_name.get(n, ())])
         self._cause_ivs = [
-            (cause, _merge([iv for n in names
-                            for iv in by_name.get(n, ())]))
+            (cause,
+             _merge(self._serialized_only(
+                 [iv for n in names for iv in by_name.get(n, ())]))
+             if names is _UPLOAD_SPANS else
+             _merge([iv for n in names
+                     for iv in by_name.get(n, ())]))
             for cause, names in CAUSE_SPANS]
         self._open = _merge(by_name.get(_ROOT, []))
         # batch ids: gaps are attached to the NEXT busy interval's
@@ -167,6 +190,18 @@ class Timeline:
              if s.name == "device" and s.attrs.get("batch")
              is not None),
             key=lambda t: t[0])
+
+    def _serialized_only(self, uploads: list) -> list:
+        """Overlapped-upload rule: an upload span that ran (with
+        positive measure) while the device computed is a PIPELINED
+        upload — the double-buffered staging the async runtime
+        exists to produce — and must not claim ``upload_serialized``
+        priority over the idle instants it happens to cover. Only
+        spans with zero busy overlap survive into the cause set; a
+        dropped span's idle coverage falls through to the next
+        matching cause, so the partition stays exact."""
+        return [iv for iv in uploads
+                if _overlap_s(self._busy, iv[0], iv[1]) <= 0.0]
 
     # --- the partition ---
 

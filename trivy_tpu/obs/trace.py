@@ -123,6 +123,16 @@ def phase_span(name: str, **attrs) -> _PhaseSpanCtx:
     return _PhaseSpanCtx(name, attrs)
 
 
+def activate_or_null(span):
+    """``with activate_or_null(sp):`` — activate ``span`` on this
+    thread, or do nothing when there is none. The async slot
+    runtime hops threads (hostpool packers, ring drain) and carries
+    the launching batch's span along this way."""
+    import contextlib
+    return span.activate() if span is not None \
+        else contextlib.nullcontext()
+
+
 class _SpanContext:
     __slots__ = ("span", "_token")
 

@@ -57,6 +57,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# NOTE: the sieve kernels donate their per-batch segment buffer
+# (freed as soon as the kernel consumes it — the async-runtime
+# slot-reuse contract); the uint16 mask output cannot alias the
+# uint8 segment input, so XLA's "Some donated buffers were not
+# usable" aliasing advisory is expected. Filtered at the
+# application level (cli/bench/pytest.ini), never here — see
+# ops/intervals.py.
+
 from ..db.compiled import ResidentTables
 from .keywords import (CODE_CHUNK, MAX_CODE_LEN, N_BLOCKS, SIEVE_CAP,
                        pack_code, pad_batch)
@@ -685,7 +693,14 @@ def _build_sieve(table: DfaTable, kind: str, run_specs: tuple,
 
     K = table.n_patterns
 
-    @jax.jit
+    # argnum 0 (the per-batch segment buffer) is DONATED: each
+    # dispatch uploads a fresh buffer, the kernel may free/reuse its
+    # HBM immediately, and collect frees the slot for the next
+    # upload (docs/performance.md §8). The band/table arrays ride in
+    # *dev and are NEVER donated — they are the resident state every
+    # dispatch of this rule-set generation shares. Callers must not
+    # reuse a segment buffer after the call (the >CAP full-fetch
+    # fallback re-uploads, secret/batch._decode).
     def full(segments, *dev):
         masks = masks_fn(segments, dev).astype(jnp.uint16)
         B = segments.shape[0]
@@ -695,10 +710,11 @@ def _build_sieve(table: DfaTable, kind: str, run_specs: tuple,
             hits = jnp.zeros((B, 0), jnp.bool_)
         return masks, hits
 
+    full = jax.jit(full, donate_argnums=(0,))
+
     if kind == "full":
         return full
 
-    @jax.jit
     def fused(segments, *dev):
         masks = masks_fn(segments, dev).astype(jnp.uint16)
         B = segments.shape[0]
@@ -714,7 +730,7 @@ def _build_sieve(table: DfaTable, kind: str, run_specs: tuple,
             hits = jnp.zeros((B, 0), jnp.bool_)
         return nhit, idx, cmasks, hits
 
-    return fused
+    return jax.jit(fused, donate_argnums=(0,))
 
 
 __all__ = [

@@ -23,6 +23,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# NOTE: the donated kernels below free their per-batch payload
+# buffers as soon as the kernel consumes them (the slot-reuse
+# contract of the async runtime). The hit output is bool while the
+# payloads are int32, so XLA can never ALIAS input to output and
+# emits its "Some donated buffers were not usable" advisory — that
+# advisory is expected here, not a bug. The CLI/bench entry points
+# (and pytest.ini) filter it at the APPLICATION level; this library
+# module deliberately does not mutate the process-global warning
+# filters, so embedders keep the signal for their own jax code.
+
 MAX_INTERVALS = 4          # per side; host falls back past this
 NEG_INF = -(2 ** 31) + 1
 POS_INF = 2 ** 31 - 1
@@ -49,6 +59,16 @@ def interval_hits_impl(pkg_rank: jax.Array, vuln_lo: jax.Array,
 
 interval_hits = jax.jit(interval_hits_impl)
 
+# donated variant for the async slot runtime (docs/performance.md
+# "Async device runtime"): every operand is a PER-BATCH payload
+# buffer staged into a dispatch-ring slot, so the kernel may reuse
+# the slot's HBM for its output — collect frees the slot for the
+# next upload instead of holding two copies alive per in-flight
+# batch. Callers must device_put fresh buffers per dispatch and
+# never touch them again (the arrays are deleted after the call).
+interval_hits_donated = jax.jit(interval_hits_impl,
+                                donate_argnums=(0, 1, 2, 3, 4, 5))
+
 
 def interval_hits_resident_impl(pkg_rank: jax.Array,
                                 row_idx: jax.Array,
@@ -65,6 +85,15 @@ def interval_hits_resident_impl(pkg_rank: jax.Array,
 
 
 interval_hits_resident = jax.jit(interval_hits_resident_impl)
+
+# resident variant: ONLY the per-batch gather operands (pkg ranks +
+# candidate row indices) are donated — argnums 2..6 are the
+# HBM-resident advisory tables shared by every dispatch of a DB
+# generation, and donating one would free the store under every
+# concurrent scanner (the buffer-donation audit's hard rule:
+# payload buffers yes, resident tables never).
+interval_hits_resident_donated = jax.jit(
+    interval_hits_resident_impl, donate_argnums=(0, 1))
 
 
 def interval_hits_host(pkg_rank, vuln_lo, vuln_hi, sec_lo, sec_hi,

@@ -15,7 +15,13 @@ those axes onto a `jax.sharding.Mesh`:
 from .interval_shard import (sharded_interval_hits,
                              sharded_interval_hits_resident)
 from .mesh import make_mesh, mesh_axis_sizes
+from .multihost import (HostTopology, global_mesh,
+                        host_shard_layout, initialize,
+                        local_indices, topology_from_env)
 from .secret_shard import sharded_blockmask
 
-__all__ = ["make_mesh", "mesh_axis_sizes", "sharded_blockmask",
-           "sharded_interval_hits", "sharded_interval_hits_resident"]
+__all__ = ["HostTopology", "global_mesh", "host_shard_layout",
+           "initialize", "local_indices", "make_mesh",
+           "mesh_axis_sizes", "sharded_blockmask",
+           "sharded_interval_hits", "sharded_interval_hits_resident",
+           "topology_from_env"]

@@ -87,12 +87,23 @@ def sharded_interval_hits(mesh, pkg_rank, v_lo, v_hi, s_lo, s_hi,
                           flags) -> np.ndarray:
     """[P] ranks × per-pair [P, M] tables → [P] bool, pairs sharded
     over every chip in the mesh."""
+    n = pkg_rank.shape[0]
+    lazy = sharded_interval_hits_async(mesh, pkg_rank, v_lo, v_hi,
+                                       s_lo, s_hi, flags)
+    return np.asarray(lazy)[:n]
+
+
+def sharded_interval_hits_async(mesh, pkg_rank, v_lo, v_hi, s_lo,
+                                s_hi, flags):
+    """Non-blocking variant for the slot runtime: pads + enqueues
+    the shard_map dispatch and returns the LAZY device array (rows
+    may carry device-multiple padding past the input length — pad
+    rows are inert, callers trim on materialize)."""
     d, r = mesh_axis_sizes(mesh)
-    (pkg_rank, v_lo, v_hi, s_lo, s_hi, flags), n = _pad_rows(
+    (pkg_rank, v_lo, v_hi, s_lo, s_hi, flags), _n = _pad_rows(
         d * r, pkg_rank, v_lo, v_hi, s_lo, s_hi, flags)
     fn = _build_pair_hits(mesh)
-    hits = np.asarray(fn(pkg_rank, v_lo, v_hi, s_lo, s_hi, flags))
-    return hits[:n]
+    return fn(pkg_rank, v_lo, v_hi, s_lo, s_hi, flags)
 
 
 def replicate_tables(mesh, tables: tuple) -> tuple:
@@ -112,8 +123,17 @@ def sharded_interval_hits_resident(mesh, pkg_rank, row_idx,
                                    tables: tuple) -> np.ndarray:
     """[P] ranks + [P] candidate-row indices against replicated
     resident tables → [P] bool."""
+    n = pkg_rank.shape[0]
+    lazy = sharded_interval_hits_resident_async(
+        mesh, pkg_rank, row_idx, tables)
+    return np.asarray(lazy)[:n]
+
+
+def sharded_interval_hits_resident_async(mesh, pkg_rank, row_idx,
+                                         tables: tuple):
+    """Non-blocking resident variant (see
+    sharded_interval_hits_async): enqueue only, caller trims."""
     d, r = mesh_axis_sizes(mesh)
-    (pkg_rank, row_idx), n = _pad_rows(d * r, pkg_rank, row_idx)
+    (pkg_rank, row_idx), _n = _pad_rows(d * r, pkg_rank, row_idx)
     fn = _build_resident_hits(mesh)
-    hits = np.asarray(fn(pkg_rank, row_idx, *tables))
-    return hits[:n]
+    return fn(pkg_rank, row_idx, *tables)

@@ -465,6 +465,12 @@ class ScanServer:
             else self.scheduler.stats()
         out["draining"] = self._draining
         out["idempotency"] = self._idem.stats()
+        if "dispatch" not in out:
+            # scheduler-off servers still report the dispatch-ring
+            # books (slot depth/occupancy/overlap — the async slot
+            # runtime runs on the direct path too)
+            from ..runtime.ring import RING_METRICS
+            out["dispatch"] = RING_METRICS.snapshot()
         if "guard" not in out:
             # scheduler-off servers still report the ingest-guard
             # counters (the scheduler's stats() already carry them)

@@ -22,7 +22,6 @@ SURVEY.md §2.6).
 
 from __future__ import annotations
 
-import contextlib
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -76,10 +75,17 @@ class BatchScanRunner:
                  cache=None, backend: str = "tpu", mesh=None,
                  secret_scanner=None, sched="off",
                  sched_config=None, artifact_option=None,
-                 fault_injector=None, tracer=None, memo=None):
+                 fault_injector=None, tracer=None, memo=None,
+                 dispatch_depth: int = 0):
         from ..obs.trace import get_tracer
+        from .ring import resolve_dispatch_depth
         self.store = store or AdvisoryStore()
         self.cache = cache if cache is not None else MemoryCache()
+        # dispatch_depth: bound on in-flight interval waves on the
+        # direct (sched=off) path — the double-buffered slot runtime
+        # (docs/performance.md §8). 0 = TRIVY_TPU_DISPATCH_DEPTH or
+        # the default 2; 1 restores the synchronous ladder
+        self.dispatch_depth = resolve_dispatch_depth(dispatch_depth)
         # memo: trivy_tpu.memo.FindingsMemo (or None) — per-layer
         # detection-verdict memoization threaded into every
         # LocalScanner this runner constructs, on both execution
@@ -125,9 +131,15 @@ class BatchScanRunner:
     @property
     def scheduler(self):
         if self._scheduler is None:
-            from ..sched import ScanScheduler
+            from ..sched import ScanScheduler, SchedConfig
+            cfg = self.sched_config
+            if cfg is None:
+                # propagate the runner's slot depth so --sched on
+                # and off honor the same --dispatch-depth knob
+                cfg = SchedConfig(
+                    dispatch_depth=self.dispatch_depth)
             self._scheduler = ScanScheduler(
-                config=self.sched_config, backend=self.backend,
+                config=cfg, backend=self.backend,
                 mesh=self.mesh, secret_scanner=self.secret_scanner,
                 tracer=self.tracer)
             self._scheduler.fault_injector = self.fault_injector
@@ -475,15 +487,14 @@ class BatchScanRunner:
         secret_s = _time.perf_counter() - t0
 
         # ---- phase 3: squash + advisory join (host) ----
-        from ..obs.trace import phase_span
+        from ..obs.trace import activate_or_null, phase_span
         t0 = _time.perf_counter()
         scanner = LocalScanner(self.cache, db, memo=self.memo)
         prepared = []
         # the join span makes this host phase visible to the idle-
         # attribution timeline (host_pack_bound — the device waits
         # while the host produces the interval jobs)
-        with (sp0.activate() if sp0 is not None
-              else contextlib.nullcontext()):
+        with activate_or_null(sp0):
             with phase_span("join", images=len(artifacts)):
                 for a in artifacts:
                     ref = a.reference
@@ -494,9 +505,19 @@ class BatchScanRunner:
                         options))
         join_s = _time.perf_counter() - t0
 
-        # ---- phase 4: ONE interval dispatch over all images ----
-        # joined AFTER the sieve enqueue so device work stays
-        # serialized on this thread (the sched executor invariant)
+        # ---- phase 4a: ENQUEUE the interval waves (async) ----
+        # the slot runtime (docs/performance.md §8): dedup + wave
+        # packing + donated-buffer uploads run here, every wave is
+        # enqueued non-blocking into a bounded dispatch ring, and
+        # the ring's drain thread materializes wave N while wave N+1
+        # packs — so the device computes THROUGH the sieve collect
+        # below instead of serializing after it. Joined AFTER the
+        # sieve enqueue so device work stays enqueue-ordered on this
+        # thread (the sched executor invariant).
+        from ..detect.batch import collect_dispatch, \
+            dispatch_jobs_async
+        from .ring import (RING_METRICS, DispatchRing, RingMetrics,
+                           TeeRingMetrics)
         t0 = _time.perf_counter()
         if sieve_future is not None:
             sieve_handle = sieve_future.result()
@@ -509,46 +530,72 @@ class BatchScanRunner:
                 all_jobs.append(job)
         detected_by_image: dict = {}
         kstats: dict = {}          # this batch's dispatch counters
-        with (sp0.activate() if sp0 is not None
-              else contextlib.nullcontext()):
-            detected_pairs = dispatch_jobs(all_jobs,
-                                           backend=options.backend,
-                                           mesh=self.mesh,
-                                           stats=kstats)
-        for idx, payload in detected_pairs:
-            detected_by_image.setdefault(idx, []).append(payload)
-        interval_s = _time.perf_counter() - t0
+        ring = None
+        # per-scan books: the ring reports into its own RingMetrics
+        # (exact for THIS scan even when concurrent scans run their
+        # own rings in-process) AND the process-wide RING_METRICS
+        # the /metrics endpoint serves
+        scan_rm = RingMetrics()
+        if all_jobs and options.backend != "cpu-ref" \
+                and self.dispatch_depth > 1:
+            ring = DispatchRing(depth=self.dispatch_depth,
+                                name="interval",
+                                metrics=TeeRingMetrics(
+                                    scan_rm, RING_METRICS))
+        try:
+            with activate_or_null(sp0):
+                ih = dispatch_jobs_async(all_jobs,
+                                         backend=options.backend,
+                                         mesh=self.mesh,
+                                         stats=kstats, ring=ring)
+            interval_s = _time.perf_counter() - t0
 
-        # ---- phase 2b: collect sieve results + late secret merge ----
-        t0 = _time.perf_counter()
-        if sieve_handle is not None:
-            from ..applier import merge_layer_secrets
-            with (sp0.activate() if sp0 is not None
-                  else contextlib.nullcontext()):
-                # collect emits its own dfa_scan(fetch)/decode/
-                # verify phase spans; the blob patch + re-merge is
-                # collect-side host work too
-                found = self.secret_scanner.collect(sieve_handle)
-                with phase_span("decode", stage="patch"):
-                    _patch_blobs(self.cache, artifacts, found)
-                    sec_stats = dict(getattr(self.secret_scanner,
-                                             "stats", {}))
-                    # re-merge EVERY artifact: a patched blob may be
-                    # shared with artifacts whose own `collected` is
-                    # empty (fleets share layers — the cached-layer
-                    # case), and their prepare() ran before the
-                    # patch landed. Nothing found → nothing patched
-                    # → prepare()'s merge already stands.
-                    if found:
-                        for a, p in zip(artifacts, prepared):
-                            blobs = [self.cache.get_blob(b)
-                                     for b in a.reference.blob_ids]
-                            p.detail.secrets = \
-                                merge_layer_secrets(blobs)
-        secret_s += _time.perf_counter() - t0
+            # ---- phase 2b: sieve collect + late secret merge ----
+            # overlaps the interval waves still computing/draining
+            t0 = _time.perf_counter()
+            if sieve_handle is not None:
+                from ..applier import merge_layer_secrets
+                with activate_or_null(sp0):
+                    # collect emits its own dfa_scan(fetch)/decode/
+                    # verify phase spans; the blob patch + re-merge
+                    # is collect-side host work too
+                    found = self.secret_scanner.collect(sieve_handle)
+                    with phase_span("decode", stage="patch"):
+                        _patch_blobs(self.cache, artifacts, found)
+                        sec_stats = dict(getattr(self.secret_scanner,
+                                                 "stats", {}))
+                        # re-merge EVERY artifact: a patched blob may
+                        # be shared with artifacts whose own
+                        # `collected` is empty (fleets share layers —
+                        # the cached-layer case), and their prepare()
+                        # ran before the patch landed. Nothing found
+                        # → nothing patched → prepare()'s merge
+                        # already stands.
+                        if found:
+                            for a, p in zip(artifacts, prepared):
+                                blobs = [self.cache.get_blob(b)
+                                         for b in
+                                         a.reference.blob_ids]
+                                p.detail.secrets = \
+                                    merge_layer_secrets(blobs)
+            secret_s += _time.perf_counter() - t0
+
+            # ---- phase 4b: collect the interval waves ----
+            t0 = _time.perf_counter()
+            with activate_or_null(sp0):
+                detected_pairs = collect_dispatch(ih)
+            for idx, payload in detected_pairs:
+                detected_by_image.setdefault(idx, []).append(payload)
+            interval_s += _time.perf_counter() - t0
+        finally:
+            if ring is not None:
+                ring.close()
         for sp in dev_spans.values():
             sp.end()
 
+        ring1 = scan_rm.snapshot()
+        ring_busy = ring1["slot_busy_s"]
+        ring_overlap = ring1["slot_overlap_s"]
         jobs_in = kstats.get("jobs_in", len(all_jobs))
         self.last_stats = {
             "images": len(images),
@@ -563,6 +610,14 @@ class BatchScanRunner:
             "interval_dedup_ratio": round(
                 1.0 - kstats.get("jobs_unique", 0) / jobs_in, 4)
             if jobs_in else 0.0,
+            # slot-runtime accounting for THIS scan (deltas of the
+            # process-wide ring books): how much of the in-flight
+            # wall ran >= 2 waves deep
+            "dispatch_depth": self.dispatch_depth,
+            "interval_waves": ih.waves,
+            "dispatch_overlap_ratio": round(
+                ring_overlap / ring_busy, 4) if ring_busy > 0
+            else 0.0,
             "secret": sec_stats,
         }
 

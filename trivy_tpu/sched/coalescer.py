@@ -53,6 +53,14 @@ class SchedConfig:
     # retry it this many times on-device before quarantining it to
     # the exact host path (docs/robustness.md)
     quarantine_retries: int = 1
+    # async device runtime (docs/performance.md §8): bound on
+    # launched-but-uncollected device slots. >= 2 double-buffers —
+    # batch N+1 packs/uploads while batch N computes; the executor
+    # shrinks the EFFECTIVE depth to 1 whenever the pipeline
+    # upstream is empty so a latency-sensitive request (admission
+    # verdicts) never parks behind a speculative batch. 1 restores
+    # the strict synchronous ladder
+    dispatch_depth: int = 2
     # flush as soon as the pipeline upstream drains (right for
     # closed-loop fleet scans: no more work is coming). Serving
     # deployments set False so ``flush_timeout_s`` acts as a real

@@ -115,9 +115,13 @@ class SchedMetrics:
         self._batch_jobs = 0
         self._bucket_bytes = 0        # padded byte capacity booked
         self._bucket_jobs = 0
-        # overlap accounting
+        # overlap accounting: device_active is a COUNTER — the
+        # async slot runtime keeps several dispatches in flight, and
+        # device busy wall is the union of their windows, not the
+        # (double-counting) sum
         self._host_active = 0
-        self._device_active = False
+        self._device_active = 0
+        self._device_since = None
         self._host_busy_s = 0.0
         self._device_busy_s = 0.0
         self._overlap_s = 0.0
@@ -168,7 +172,7 @@ class SchedMetrics:
     # --- overlap accounting ---
 
     def _update_both(self, now: float) -> None:
-        both = self._device_active and self._host_active > 0
+        both = self._device_active > 0 and self._host_active > 0
         if both and self._both_since is None:
             self._both_since = now
         elif not both and self._both_since is not None:
@@ -192,15 +196,22 @@ class SchedMetrics:
     def device_begin(self) -> float:
         now = time.monotonic()
         with self._lock:
-            self._device_active = True
+            self._device_active += 1
+            if self._device_active == 1:
+                self._device_since = now
             self._update_both(now)
         return now
 
     def device_end(self, t0: float) -> None:
         now = time.monotonic()
         with self._lock:
-            self._device_active = False
-            self._device_busy_s += now - t0
+            self._device_active -= 1
+            if self._device_active == 0 and \
+                    self._device_since is not None:
+                # union accounting: busy wall accrues only when the
+                # LAST overlapping dispatch window closes
+                self._device_busy_s += now - self._device_since
+                self._device_since = None
             self._update_both(now)
 
     # --- snapshot ---
@@ -259,6 +270,12 @@ class SchedMetrics:
                 "latency": {p: h.to_dict()
                             for p, h in self.hist.items()},
             }
+        # dispatch-ring accounting (runtime/ring.py): current/max
+        # dispatch depth, slot occupancy, and the overlap ratio the
+        # async runtime buys — process-wide like the guard totals,
+        # so sched-off direct scans report it too
+        from ..runtime.ring import RING_METRICS
+        out["dispatch"] = RING_METRICS.snapshot()
         # ingest-guard counters (trivy_tpu/guard): process-wide by
         # design — budgets are per-target and short-lived, the trip
         # totals are what an operator watches on /metrics

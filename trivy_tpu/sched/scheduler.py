@@ -21,6 +21,20 @@ the round-5 mesh curve lacked. Iteration-level scheduling à la
 Orca/vLLM: requests join whichever batch is forming when their host
 analysis lands, not the batch they arrived with.
 
+Async slot runtime (docs/performance.md §8): the executor LAUNCHES
+each coalesced batch — segment pack, ``jax.device_put`` staging,
+non-blocking donated-kernel enqueue — into a bounded dispatch ring
+(``SchedConfig.dispatch_depth``, default 2) and immediately takes
+the next batch, so batch N+1 packs and uploads while batch N
+computes. The ring's drain thread COLLECTS slots in FIFO order
+(materialize → decode → patch → finish fan-out); a full ring parks
+the executor under a typed ``slot_wait`` span. Occupancy feedback:
+when nothing is queued, analyzing, or pending coalesce, the
+effective depth shrinks to 1 — an interactive admission verdict
+never waits behind a speculative batch. A slot whose launch or
+collect fails falls back to the synchronous bisect/quarantine
+ladder, so poison isolation is unchanged.
+
 Cross-request consistency: two concurrent requests can share a layer
 blob (fleets share file trees). A request that analyzed a layer will
 patch that blob's secrets only when its batch's sieve resolves; any
@@ -107,6 +121,12 @@ class ScanScheduler:
                                                  "tenancy", None))
         self.metrics.set_depth_gauge(self.queue.depth)
         self.coalescer = Coalescer(self.config)
+        # dispatch ring (runtime/ring.py): bounds launched-but-
+        # uncollected device slots and owns the collect drain thread
+        from ..runtime.ring import DispatchRing
+        self.ring = DispatchRing(
+            depth=max(1, getattr(self.config, "dispatch_depth", 2)),
+            name="sched")
         self._pool: Optional[ThreadPoolExecutor] = None
         self._threads: list = []
         self._cv = threading.Condition()
@@ -159,6 +179,13 @@ class ScanScheduler:
             self._fail(req, SchedulerClosed("scheduler closed"))
         for req in self.coalescer.drain():
             self._fail(req, SchedulerClosed("scheduler closed"))
+        # drain the dispatch ring BEFORE the pool stops: in-flight
+        # device slots complete (a deadline never cancels device
+        # work already launched), their patches land, and their
+        # finish tasks still find a live pool to run on — collected
+        # even on wait=False, because an abandoned slot's requests
+        # would never resolve
+        self.ring.close(collect=True)
         if self._pool is not None:
             self._pool.shutdown(wait=wait)
         # a second drain AFTER the pool settles: an _analyze that was
@@ -464,7 +491,21 @@ class ScanScheduler:
         for req in self.coalescer.drain():
             self._fail(req, SchedulerClosed("scheduler closed"))
 
+    def _effective_depth(self) -> int:
+        """Occupancy feedback for the dispatch ring: the configured
+        depth while work is queued/analyzing/pending (speculative
+        batches pay for themselves), shrunk to 1 when the pipeline
+        upstream is empty — the next request to arrive gets the
+        device as soon as the current batch drains, not after a
+        speculative slot ahead of it."""
+        cfg = max(1, getattr(self.config, "dispatch_depth", 2))
+        if cfg > 1 and self._upstream_idle() \
+                and self.coalescer.pending() == 0:
+            return 1
+        return cfg
+
     def _execute(self, batch: Batch) -> None:
+        from ..runtime.ring import RingClosed
         reqs = [r for r in batch.requests if not self._sweep(r)]
         if not reqs:
             return
@@ -486,10 +527,189 @@ class ScanScheduler:
                     sp.set("occupancy", occ)
                 sp.end()
 
-        results = self._dispatch_isolated(
-            reqs, batch.group or self.backend, batch_id=bid)
+        group = batch.group or self.backend
+        try:
+            # capacity first, then launch (pack + upload + enqueue)
+            # on THIS thread, collect on the ring's drain thread —
+            # the loop takes the next batch while this one computes.
+            # The first request's root is active around the submit
+            # so a ring-full park records its slot_wait span (the
+            # timeline charges the stall to the batch it delayed)
+            import contextlib
+            root = reqs[0].span_root
+            ctx = root.activate() if root is not None \
+                and not root.noop else contextlib.nullcontext()
+            launched: dict = {}
 
-        # patch + event-set happen HERE, on the device thread, so
+            def _do_launch():
+                launched["slot"] = self._launch(reqs, group, bid)
+                return launched["slot"]
+
+            with ctx:
+                self.ring.submit(
+                    self._collect_slot,
+                    depth=self._effective_depth(),
+                    label=f"batch:{bid}",
+                    launch=_do_launch)
+        except RingClosed:
+            slotp = launched.get("slot")
+            if slotp is not None:
+                # the ring closed between a SUCCESSFUL launch and
+                # the slot append: device work is already enqueued,
+                # so collect it inline — spans end, payload tags
+                # restore, device accounting balances, and the
+                # close(collect=True) "in-flight work completes"
+                # contract holds
+                self._collect_slot(slotp)
+            else:
+                for r in reqs:
+                    self._fail(r,
+                               SchedulerClosed("scheduler closed"))
+        except Exception as e:       # noqa: BLE001 — a failed
+            # launch (fault injection fires at dispatch, packing
+            # errors) falls back to the synchronous isolated ladder:
+            # bisect corners the poison exactly as before
+            log.warning("async launch failed for %d requests "
+                        "(%r); synchronous fallback", len(reqs), e)
+            results = self._dispatch_isolated(reqs, group,
+                                              batch_id=bid)
+            self._resolve_batch(reqs, results)
+
+    def _launch(self, reqs: list, group: str, bid: int) -> dict:
+        """Non-blocking half of one batch dispatch: flatten + tag
+        payloads, enqueue the sieve and the interval waves (donated
+        per-batch buffers), return the slot payload the drain thread
+        collects. Raises on launch failure with payload tags
+        restored and device spans error-ended."""
+        from ..detect.batch import dispatch_jobs_async
+
+        spans = []
+        for r in reqs:
+            sp = self.tracer.child(r.span_root, "device")
+            if not sp.noop:
+                sp.set("batch", bid)
+                sp.set("requests", len(reqs))
+            spans.append(sp)
+        slot = {"reqs": reqs, "spans": spans, "group": group,
+                "bid": bid, "wrapped": [], "owner": [],
+                "local": [], "sieve": None, "ih": None,
+                "kstats": {}, "t0": None}
+        try:
+            if self.fault_injector is not None:
+                self.fault_injector.on_device_dispatch(
+                    [r.name for r in reqs])
+
+            # flatten sieve candidates; owner map brings results
+            # home by ENTRY INDEX (paths repeat across images — see
+            # secret.batch)
+            files = []
+            for i, r in enumerate(reqs):
+                for j, (path, content) in enumerate(
+                        r.work.candidates):
+                    files.append((path, content))
+                    slot["owner"].append(i)
+                    slot["local"].append(j)
+
+            # payloads are tagged with the request's batch index for
+            # the duration of the dispatch and restored at collect —
+            # a failed slot restores before the sync fallback
+            # re-tags against its own indices
+            for i, r in enumerate(reqs):
+                for job in r.work.jobs:
+                    slot["wrapped"].append((job, job.payload))
+                    job.payload = (i, job.payload)
+
+            slot["t0"] = self.metrics.device_begin()
+            # batch-shared phases (segment pack, H2D staging, wave
+            # enqueue) record under the FIRST request's device span
+            with spans[0].activate():
+                if files and self.secret_scanner is not None:
+                    slot["sieve"] = \
+                        self.secret_scanner.dispatch_files(files)
+                all_jobs = [job for job, _ in slot["wrapped"]]
+                if all_jobs:
+                    slot["ih"] = dispatch_jobs_async(
+                        all_jobs, backend=group, mesh=self.mesh,
+                        stats=slot["kstats"])
+            return slot
+        except Exception as e:       # noqa: BLE001
+            self._unwind_slot(slot, error=e)
+            raise
+
+    def _unwind_slot(self, slot: dict, error=None) -> None:
+        """Restore payload tags + close accounting for a slot that
+        will not produce results itself (launch/collect failure —
+        the sync fallback re-dispatches from a clean state)."""
+        for job, orig in slot["wrapped"]:
+            job.payload = orig
+        if slot["t0"] is not None:
+            self.metrics.device_end(slot["t0"])
+        for sp in slot["spans"]:
+            if error is not None:
+                sp.event("device_failed", error=repr(error))
+            sp.end("error" if error is not None else None)
+
+    def _collect_slot(self, slot: dict) -> None:
+        """Drain-thread half: materialize the interval waves (the
+        device wall passes here), collect the sieve, then patch +
+        finish fan-out. A collect failure falls back to the
+        synchronous bisect/quarantine ladder."""
+        from ..detect.batch import collect_dispatch
+
+        reqs = slot["reqs"]
+        spans = slot["spans"]
+        try:
+            with spans[0].activate():
+                detected_by: dict = {}
+                if slot["ih"] is not None:
+                    for i, payload in collect_dispatch(slot["ih"]):
+                        detected_by.setdefault(i, []).append(
+                            payload)
+                    with self._lock:
+                        self._kernel_s += slot["kstats"].get(
+                            "device_s", 0.0)
+                found_by: dict = {}
+                if slot["sieve"] is not None:
+                    for idx, secret in self.secret_scanner.collect(
+                            slot["sieve"]):
+                        found_by.setdefault(
+                            slot["owner"][idx], []).append(
+                            (slot["local"][idx], secret))
+        except Exception as e:       # noqa: BLE001
+            log.warning("slot collect failed for %d requests "
+                        "(%r); synchronous fallback", len(reqs), e)
+            self._unwind_slot(slot, error=e)
+            results = self._dispatch_isolated(
+                reqs, slot["group"], batch_id=slot["bid"])
+            self._resolve_safe(reqs, results)
+            return
+        for job, orig in slot["wrapped"]:
+            job.payload = orig
+        self.metrics.device_end(slot["t0"])
+        self.metrics.observe("device",
+                             time.monotonic() - slot["t0"])
+        for sp in spans:
+            sp.end()
+        results = {id(r): (found_by.get(i, []),
+                           detected_by.get(i, []))
+                   for i, r in enumerate(reqs)}
+        self._resolve_safe(reqs, results)
+
+    def _resolve_safe(self, reqs: list, results: dict) -> None:
+        """_resolve_batch, but a raising resolution can never leak a
+        request: on the drain thread nobody reads the slot's error
+        (results flow through the requests themselves), so anything
+        unresolved fails typed here."""
+        try:
+            self._resolve_batch(reqs, results)
+        except Exception as e:       # noqa: BLE001
+            log.warning("batch resolution failed: %r", e)
+            for r in reqs:
+                self._fail(r, e)
+
+    def _resolve_batch(self, reqs: list, results: dict) -> None:
+        # patch + event-set happen HERE, on the collecting thread
+        # (ring drain, or the executor on the sync fallback), so
         # every patch event is resolved without touching the worker
         # pool — a finish waiting on another request's patch can
         # never starve the work that would satisfy it

@@ -440,10 +440,14 @@ class BatchSecretScanner:
         fn = self.table.fused_sieve(specs, platform)
         with phase_span("h2d_upload", bytes=int(buf.nbytes)):
             dev = jax.device_put(pad_batch(buf))
+        padded_rows = int(dev.shape[0])
         with phase_span("dfa_scan", segments=int(buf.shape[0]),
                         patterns=self.table.n_patterns):
+            # the segment buffer is donated to the kernel — ``dev``
+            # is dead after this call (the >CAP fallback re-uploads)
             nhit, idx, cm, h = fn(dev, *tbl)
-        handle.update(mode="fused", platform=platform, dev=dev,
+        handle.update(mode="fused", platform=platform,
+                      padded_rows=padded_rows,
                       tbl=tbl, nhit=nhit, idx=idx, cm=cm, h=h)
         handle["device_s"] += _time.perf_counter() - t0
         return handle
@@ -498,12 +502,17 @@ class BatchSecretScanner:
                 nhit = int(handle["nhit"])
                 cm = handle["cm"]
                 h = handle["h"]
-                if nhit > min(cm.shape[0], handle["dev"].shape[0]):
+                if nhit > min(cm.shape[0], handle["padded_rows"]):
                     # fetch the full mask array; run hits (h) were
-                    # already computed by the fused dispatch
+                    # already computed by the fused dispatch. The
+                    # fused dispatch DONATED its segment buffer
+                    # (ops/dfa.py), so this rare overflow path
+                    # re-uploads rather than reuse freed HBM
+                    import jax as _jax
                     full = self.table.full_sieve(
                         (), handle["platform"])
-                    m, _ = full(handle["dev"], *handle["tbl"])
+                    m, _ = full(_jax.device_put(pad_batch(buf)),
+                                *handle["tbl"])
                     masks = np.asarray(m)[:B, :K]
                     seg_nz, code_nz = np.nonzero(masks)
                     hit_vals = masks[seg_nz, code_nz]
