@@ -24,6 +24,34 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# opt-in runtime lock-order witness for the WHOLE run
+# (docs/static-analysis.md): TRIVY_TPU_LOCK_WITNESS=1 wraps every
+# lock trivy_tpu constructs from here on and raises on an
+# acquisition-order cycle or a host-pool self-join
+from trivy_tpu.analysis.witness import \
+    maybe_install_from_env  # noqa: E402
+
+maybe_install_from_env()
+
+
+@pytest.fixture
+def lock_witness():
+    """Install the runtime lock-order witness for one test — the
+    seeded race storms run under it, so the PR-4 (lock-order
+    cycle) and PR-5 (pool self-join) deadlock classes raise
+    loudly inside the storm instead of silently returning. If the
+    session-level env witness is already active, it is reused and
+    left installed."""
+    from trivy_tpu.analysis import witness as w
+
+    pre = w.active_witness()
+    wit = w.install_witness()
+    try:
+        yield wit
+    finally:
+        if pre is None:
+            w.uninstall_witness()
+
 
 @pytest.fixture(scope="session")
 def mesh8():

@@ -302,6 +302,11 @@ class TestDrainShutdown:
 # ---------------------------------------------------------------
 
 class TestRaceAccounting:
+    # the 32-thread storm runs under the runtime lock-order
+    # witness: an acquisition-order cycle or a host-pool self-join
+    # anywhere in the scheduler/ring/tenant path raises instead of
+    # waiting for the deadlock interleaving
+    @pytest.mark.usefixtures("lock_witness")
     def test_every_submit_one_terminal_state(self, tmp_path,
                                              make_faults):
         import numpy as np

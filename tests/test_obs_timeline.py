@@ -3,13 +3,13 @@ partition invariants on seeded random span trees, the gap-cause
 priority rules, per-batch breakdowns, clock discipline (monotonic
 stamps only — a wall-clock step mid-batch moves nothing), and the
 end-to-end reconstruction over a real fleet scan on both --sched
-modes. Plus the tree-wide lint: no ``time.time()`` arithmetic inside
-``obs/`` span/timeline math."""
+modes. The old grep-based ``time.time()`` lint that lived here moved
+to the AST ``monotonic-clock`` rule in ``trivy_tpu/analysis`` —
+tree-wide now, not just ``obs/`` (tests/test_analysis.py)."""
 
 from __future__ import annotations
 
 import os
-import re
 from collections import namedtuple
 
 import numpy as np
@@ -317,32 +317,11 @@ class TestClockDiscipline:
             0.01, abs=0.05)
         assert tl.busy_s == pytest.approx(0.01, abs=0.05)
 
-    def test_monotonic_only_lint(self):
-        """Tree-wide lint: no ``time.time()`` arithmetic anywhere in
-        obs/ — wall time may be STORED as a label but never added to
-        or subtracted from anything (a wall step would corrupt span
-        durations, timeline gaps, profiler buckets and SLO
-        windows)."""
-        obs_dir = os.path.join(
-            os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))),
-            "trivy_tpu", "obs")
-        # time.time() adjacent to an arithmetic operator, either side
-        bad = re.compile(
-            r"(time\.time\(\)\s*[-+*/])|([-+*/]\s*time\.time\(\))")
-        offenders = []
-        for fn in sorted(os.listdir(obs_dir)):
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(obs_dir, fn),
-                      encoding="utf-8") as f:
-                for i, line in enumerate(f, 1):
-                    if bad.search(line):
-                        offenders.append(f"{fn}:{i}: "
-                                         f"{line.strip()}")
-        assert not offenders, \
-            "wall-clock arithmetic in obs/ (monotonic only):\n" + \
-            "\n".join(offenders)
+    # The grep-based monotonic-only lint that lived here was
+    # superseded by the AST ``monotonic-clock`` rule
+    # (trivy_tpu/analysis, tests/test_analysis.py): exact on the
+    # syntax tree instead of regex-adjacent, and swept tree-wide —
+    # sched/, watch/, memo/ now carry the same discipline obs/ did.
 
 
 def _fleet(tmp_path, n):

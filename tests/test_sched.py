@@ -395,12 +395,14 @@ class TestServing:
         return srv, httpd, \
             f"http://127.0.0.1:{httpd.server_address[1]}"
 
+    @pytest.mark.usefixtures("lock_witness")
     def test_concurrent_scans_no_result_bleed(self):
         """Eight clients push DIFFERENT blobs and scan concurrently;
         coalesced dispatches must never leak one request's findings
         into another's response. End-to-end with a 1s flush: the
         idle-flush fires as soon as the queue drains, so latency
-        stays well under the timeout."""
+        stays well under the timeout. Runs under the lock-order
+        witness (docs/static-analysis.md)."""
         from trivy_tpu.rpc.client import RemoteCache, RemoteScanner
         from trivy_tpu.scan.local import ScanTarget
         from trivy_tpu.types import ScanOptions

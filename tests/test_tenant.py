@@ -271,11 +271,13 @@ class TestSchedulerTenancy:
         finally:
             sched.close()
 
+    @pytest.mark.usefixtures("lock_witness")
     def test_race_books_balance_per_tenant(self, make_faults):
         """(a) K tenants submit concurrently against quotas, rate
         limits, deadlines, and injected device failures: every
         request ends in exactly one of ok/degraded/429/503/408 and
-        the global AND per-tenant books balance."""
+        the global AND per-tenant books balance. Runs under the
+        lock-order witness (docs/static-analysis.md)."""
         inj = make_faults("device_fail_rate=0.3,seed=11")
         tenancy = TenancyConfig(tenants={
             "flooder": TenantConfig(name="flooder", rate=50.0,

@@ -1,0 +1,37 @@
+"""Repo-invariant static analysis (docs/static-analysis.md).
+
+Two complementary halves:
+
+* :mod:`analysis.engine` + :mod:`analysis.rules` — an AST lint
+  engine whose rules encode this repo's own concurrency/donation/
+  clock invariant history (the PR-4 gauge-under-lock self-deadlock,
+  the PR-5 hostpool self-join, the PR-8 monotonic-clock discipline,
+  the PR-11 donated-buffer reuse rules, the PR-7/PR-8 label-
+  cardinality folds). ``python -m trivy_tpu.analysis`` runs every
+  rule over the tree and exits 1 on unsuppressed findings;
+  ``pytest -m lint`` wires the same sweep into tier-1.
+* :mod:`analysis.witness` — a dynamic complement: an opt-in
+  instrumented-lock wrapper (``TRIVY_TPU_LOCK_WITNESS=1``) that
+  records the process-wide lock-acquisition order graph and raises
+  on a cycle or on a blocking pool-join from a pool thread, wired
+  into the seeded race suites so the historical deadlocks cannot
+  silently return.
+"""
+
+from .engine import (  # noqa: F401
+    Engine,
+    Finding,
+    Suppression,
+    analyze_source,
+    analyze_tree,
+    default_engine,
+    parse_suppressions,
+)
+from .witness import (  # noqa: F401
+    LockOrderViolation,
+    LockWitness,
+    OrderGraph,
+    PoolSelfJoinError,
+    install_witness,
+    uninstall_witness,
+)

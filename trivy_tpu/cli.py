@@ -15,7 +15,17 @@ import os
 import sys
 from tarfile import TarError as tarfile_error
 
-from . import __version__
+# opt-in runtime lock-order witness (docs/static-analysis.md):
+# TRIVY_TPU_LOCK_WITNESS=1 must install BEFORE the heavy submodule
+# imports below construct the metric-singleton locks
+# (DETECT/RING/SECRET_METRICS...), matching the test conftest's
+# install-before-any-import order — witness.py itself imports only
+# os/sys/threading
+from .analysis.witness import maybe_install_from_env
+
+maybe_install_from_env()
+
+from . import __version__  # noqa: E402
 from .artifact import (ArtifactOption, FSCache, ImageArtifact,
                        LocalFSArtifact, load_image)
 from .db import AdvisoryStore, load_fixtures

@@ -123,6 +123,9 @@ class HostProfiler:
             t0 = time.process_time()
             try:
                 self.sample_once(skip_thread=me)
+            # lint: disable=bare-except-at-seam -- the ~49Hz tick
+            # must never take the host down or pay logging on the
+            # hot path; a failed tick self-heals next period
             except Exception:       # noqa: BLE001 — the profiler
                 pass                # must never take the host down
             self.overhead_s += time.process_time() - t0
@@ -255,6 +258,9 @@ class _DeviceTraceCtx:
         if self._jax_trace is not None:
             try:
                 self._jax_trace.__exit__(*(exc or (None,) * 3))
+            # lint: disable=bare-except-at-seam -- no jax or no
+            # profiler plugin: the host-only profile is still
+            # written below, which is the degraded contract
             except Exception:       # noqa: BLE001
                 pass
         try:

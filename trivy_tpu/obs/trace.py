@@ -460,10 +460,19 @@ def get_tracer() -> Tracer:
     flight recorder's log ring attached to the trivy_tpu logger)."""
     global _TRACER
     if _TRACER is None:
+        candidate = Tracer()
         with _TRACER_LOCK:
             if _TRACER is None:
-                tracer = Tracer()
-                from .recorder import attach_ring_handler
-                attach_ring_handler(tracer.recorder)
-                _TRACER = tracer
+                _TRACER = candidate
+                won = candidate
+            else:
+                won = None
+        if won is not None:
+            # the handler attach takes the recorder's and logging's
+            # locks — outside _TRACER_LOCK (lint: lock-discipline).
+            # A racing get_tracer() may briefly see the tracer
+            # before its log ring attaches; only the first
+            # microseconds of log capture can miss.
+            from .recorder import attach_ring_handler
+            attach_ring_handler(won.recorder)
     return _TRACER

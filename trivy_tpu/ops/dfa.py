@@ -412,6 +412,7 @@ def build_table(literals, chains) -> DfaTable:
     lits = tuple(sorted({x.lower() for x in literals if x}))
     chs = tuple(sorted(set(chains), key=repr))
     fp = hashlib.sha256(repr((lits, chs)).encode()).hexdigest()
+    evicted = []
     with _TABLE_LOCK:
         table = _TABLE_CACHE.get(fp)
         if table is None:
@@ -420,8 +421,12 @@ def build_table(literals, chains) -> DfaTable:
             while len(_TABLE_CACHE) > _TABLE_CACHE_MAX:
                 # FIFO eviction; dropped tables free their HBM once
                 # the last in-flight dispatch releases its buffers
-                old = _TABLE_CACHE.pop(next(iter(_TABLE_CACHE)))
-                old.invalidate_device()
+                evicted.append(_TABLE_CACHE.pop(
+                    next(iter(_TABLE_CACHE))))
+    for old in evicted:
+        # invalidate_device takes the table's ResidentTables lock —
+        # outside _TABLE_LOCK (lint: lock-discipline)
+        old.invalidate_device()
     return table
 
 

@@ -259,6 +259,7 @@ class DispatchRing:
         already launched (device work in flight completes — the
         scheduler's shutdown contract); False abandons them with
         RingClosed."""
+        abandoned = []
         with self._cv:
             self._closed = True
             if not collect:
@@ -268,9 +269,13 @@ class DispatchRing:
                 while self._slots:
                     slot = self._slots.popleft()
                     slot.error = RingClosed("ring closed")
-                    slot.done.set()
-                    self.metrics.slot_end(error=True)
+                    abandoned.append(slot)
             self._cv.notify_all()
+        for slot in abandoned:
+            # waiters wake and metrics book outside the cv — the
+            # metric sink takes its own lock (lint: lock-discipline)
+            slot.done.set()
+            self.metrics.slot_end(error=True)
         t = self._thread
         if t is not None:
             t.join(timeout=30)
@@ -296,24 +301,33 @@ class DispatchRing:
         speculative batch."""
         from ..obs.trace import phase_span
         bound = self.depth if depth is None else max(1, int(depth))
-        with self._cv:
-            if self._closed:
-                raise RingClosed("ring closed")
-            if self._in_flight_locked() + self._reserved >= bound:
-                t0 = time.monotonic()
-                # a full ring is a typed stall: the pipeline is
-                # gated on the drain thread, and the timeline
-                # attributes device idle under this span to
-                # slot_wait (obs/timeline.py)
-                with phase_span("slot_wait", ring=self.name,
-                                depth=bound):
-                    while self._in_flight_locked() + self._reserved \
-                            >= bound and not self._closed:
-                        self._cv.wait(0.1)
-                self.metrics.note_wait(time.monotonic() - t0)
+        waited_s = 0.0
+        try:
+            with self._cv:
                 if self._closed:
                     raise RingClosed("ring closed")
-            self._reserved += 1
+                if self._in_flight_locked() + self._reserved \
+                        >= bound:
+                    t0 = time.monotonic()
+                    # a full ring is a typed stall: the pipeline is
+                    # gated on the drain thread, and the timeline
+                    # attributes device idle under this span to
+                    # slot_wait (obs/timeline.py)
+                    with phase_span("slot_wait", ring=self.name,
+                                    depth=bound):
+                        while self._in_flight_locked() + \
+                                self._reserved >= bound and \
+                                not self._closed:
+                            self._cv.wait(0.1)
+                    waited_s = time.monotonic() - t0
+                    if self._closed:
+                        raise RingClosed("ring closed")
+                self._reserved += 1
+        finally:
+            if waited_s:
+                # the metric sink takes its own lock — book the
+                # wait outside the cv (lint: lock-discipline)
+                self.metrics.note_wait(waited_s)
         try:
             if launch is not None:
                 # heavy work OUTSIDE the lock; a raising launch
@@ -324,15 +338,26 @@ class DispatchRing:
                 self._reserved -= 1
                 self._cv.notify_all()
             raise
-        with self._cv:
-            self._reserved -= 1
-            if self._closed:
+        # slot_begin BEFORE the slot becomes drainable: the drain
+        # thread's slot_end must never run first, and the metric
+        # sink's own lock must not nest under the cv (lint:
+        # lock-discipline). A close() racing in below books the
+        # phantom slot closed again (launched == collected holds).
+        self.metrics.slot_begin()
+        booked = False
+        try:
+            with self._cv:
+                self._reserved -= 1
+                if self._closed:
+                    self._cv.notify_all()
+                    raise RingClosed("ring closed")
+                slot = Slot(label, payload, collect)
+                self._slots.append(slot)
+                booked = True
                 self._cv.notify_all()
-                raise RingClosed("ring closed")
-            slot = Slot(label, payload, collect)
-            self._slots.append(slot)
-            self.metrics.slot_begin()
-            self._cv.notify_all()
+        finally:
+            if not booked:
+                self.metrics.slot_end(error=True)
         self._ensure_thread()
         return slot
 

@@ -32,6 +32,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..utils import get_logger
+
+log = get_logger("sched.queue")
+
 
 class SchedError(RuntimeError):
     """Base class for scheduler errors."""
@@ -129,8 +133,9 @@ class ScanRequest:
         if self.on_done is not None:
             try:
                 self.on_done(self)
-            except Exception:       # noqa: BLE001 — never propagate
-                pass
+            except Exception as e:  # noqa: BLE001 — never propagate
+                log.warning("on_done callback failed for %r: %r",
+                            self.name, e)
         return True
 
     def set_result(self, result) -> bool:

@@ -241,12 +241,18 @@ class TenantBook:
         if c is None:
             c = {k: 0 for k in
                  ("admitted",) + self.OUTCOMES + self.REJECTIONS}
+            # lint: disable=unbounded-label-cardinality -- tenant
+            # ids are pre-folded by TenantQueue._resolve: dynamic
+            # overflow past max_tenants lands on the anonymous
+            # tenant before any book call sees it
             self._counters[tenant] = c
         return c
 
     def inc(self, tenant: str, event: str, n: int = 1) -> None:
         with self._lock:
             slot = self._slot(tenant)
+            # lint: disable=unbounded-label-cardinality -- event
+            # names are code-literal outcome/rejection kinds
             slot[event] = slot.get(event, 0) + n
 
     def observe(self, tenant: str, seconds: float,
@@ -254,6 +260,8 @@ class TenantBook:
         with self._lock:
             h = self._hist.get(tenant)
             if h is None:
+                # lint: disable=unbounded-label-cardinality -- ids
+                # pre-folded to max_tenants (anon) upstream
                 h = self._hist[tenant] = LatencyHistogram()
             h.observe(seconds, exemplar=trace_id)
 
@@ -345,81 +353,106 @@ class TenantQueue:
 
     def put(self, req: ScanRequest, block: bool = False,
             timeout: Optional[float] = None) -> None:
-        with self._cv:
-            if self._closed:
-                raise SchedulerClosed("scheduler is closed")
-            tenant, sub = self._resolve(req)
-            cfg = sub.cfg
-            # per-tenant gates FIRST: an over-limit tenant gets its
-            # own 429 even when the queue is also globally full —
-            # the shed must land on the offender
-            if sub.bucket is not None:
-                wait = sub.bucket.take()
-                if wait > 0.0:
-                    self.book.inc(tenant, "rejected_rate")
-                    raise RateLimitedError(
-                        f"tenant {tenant!r} over rate limit "
-                        f"({cfg.rate:g}/s)",
-                        retry_after_s=wait, tenant=tenant)
-            self._check_quotas(tenant, sub)
-            if not block and self._total >= self.maxsize:
-                self.book.inc(tenant, "rejected_503")
-                raise QueueFullError(
-                    f"scan queue full ({self.maxsize} pending)")
-            deadline = (time.monotonic() + timeout
-                        if timeout is not None else None)
-            waited = False
-            while self._total >= self.maxsize:
-                remaining = None if deadline is None else \
-                    deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    self.book.inc(tenant, "rejected_503")
-                    raise QueueFullError(
-                        f"scan queue full ({self.maxsize} pending)")
-                self._cv.wait(remaining)
-                waited = True
+        # admission accounting (TenantBook takes its own lock) is
+        # booked AFTER the cv releases (lint: lock-discipline) —
+        # the decision is made under the lock, the book entry
+        # follows microseconds later, and books still balance
+        # because every exit path below sets exactly one event
+        tenant = ""
+        event = ""
+        try:
+            with self._cv:
                 if self._closed:
                     raise SchedulerClosed("scheduler is closed")
-            if waited:
-                # re-check the quotas after any blocking wait: N
-                # waiters could all have passed the pre-wait check
-                # against the same headroom and overshoot the quota
-                # by N-1 once capacity frees
+                tenant, sub = self._resolve(req)
+                cfg = sub.cfg
+                # per-tenant gates FIRST: an over-limit tenant gets
+                # its own 429 even when the queue is also globally
+                # full — the shed must land on the offender
+                if sub.bucket is not None:
+                    wait = sub.bucket.take()
+                    if wait > 0.0:
+                        event = "rejected_rate"
+                        raise RateLimitedError(
+                            f"tenant {tenant!r} over rate limit "
+                            f"({cfg.rate:g}/s)",
+                            retry_after_s=wait, tenant=tenant)
                 self._check_quotas(tenant, sub)
-            if not sub.queued:
-                # (re)activation: an idle tenant resumes at the
-                # CURRENT virtual time — idleness earns no credit,
-                # so a returning tenant cannot monopolize the queue
-                sub.pass_value = max(sub.pass_value, self._vtime)
-            self._seq += 1
-            heapq.heappush(
-                sub.heap,
-                (-int(getattr(req, "priority", 0) or 0),
-                 self._seq, req))
-            sub.queued += 1
-            sub.inflight += 1
-            self._total += 1
-            self.book.inc(tenant, "admitted")
-            self._cv.notify_all()
+                if not block and self._total >= self.maxsize:
+                    event = "rejected_503"
+                    raise QueueFullError(
+                        f"scan queue full "
+                        f"({self.maxsize} pending)")
+                deadline = (time.monotonic() + timeout
+                            if timeout is not None else None)
+                waited = False
+                while self._total >= self.maxsize:
+                    remaining = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        event = "rejected_503"
+                        raise QueueFullError(
+                            f"scan queue full "
+                            f"({self.maxsize} pending)")
+                    self._cv.wait(remaining)
+                    waited = True
+                    if self._closed:
+                        raise SchedulerClosed(
+                            "scheduler is closed")
+                if waited:
+                    # re-check the quotas after any blocking wait:
+                    # N waiters could all have passed the pre-wait
+                    # check against the same headroom and overshoot
+                    # the quota by N-1 once capacity frees
+                    self._check_quotas(tenant, sub)
+                if not sub.queued:
+                    # (re)activation: an idle tenant resumes at the
+                    # CURRENT virtual time — idleness earns no
+                    # credit, so a returning tenant cannot
+                    # monopolize the queue
+                    sub.pass_value = max(sub.pass_value,
+                                         self._vtime)
+                self._seq += 1
+                heapq.heappush(
+                    sub.heap,
+                    (-int(getattr(req, "priority", 0) or 0),
+                     self._seq, req))
+                sub.queued += 1
+                sub.inflight += 1
+                self._total += 1
+                event = "admitted"
+                self._cv.notify_all()
+        except BaseException as e:
+            # quota rejections raised inside _check_quotas carry
+            # their book event; closed-scheduler exits book nothing
+            event = getattr(e, "book_event", event)
+            raise
+        finally:
+            if tenant and event:
+                self.book.inc(tenant, event)
 
     def _check_quotas(self, tenant: str, sub: "_Sub") -> None:
         """Admission quotas, under the queue lock. Raises the typed
-        429 so the tenant sheds its own load."""
+        429 — tagged with its book event, which ``put`` records
+        once the lock is released — so the tenant sheds its own
+        load."""
         cfg = sub.cfg
         if cfg.max_queued and sub.queued >= cfg.max_queued:
-            self.book.inc(tenant, "rejected_quota")
-            raise RateLimitedError(
+            e = RateLimitedError(
                 f"tenant {tenant!r} queue quota reached "
                 f"({cfg.max_queued} queued)",
                 retry_after_s=self._quota_hint(cfg),
                 tenant=tenant)
+            e.book_event = "rejected_quota"
+            raise e
         if cfg.max_inflight and sub.inflight >= cfg.max_inflight:
-            self.book.inc(tenant, "rejected_quota")
-            raise RateLimitedError(
+            e = RateLimitedError(
                 f"tenant {tenant!r} in-flight quota reached "
                 f"({cfg.max_inflight} unresolved)",
                 retry_after_s=self._quota_hint(cfg),
                 tenant=tenant)
+            e.book_event = "rejected_quota"
+            raise e
 
     def _quota_hint(self, cfg: TenantConfig) -> float:
         # Retry-After for a quota rejection: the time the tenant's

@@ -319,6 +319,9 @@ class AdmissionController:
             req = self.runner.submit_path(
                 path, self._options(), tenant=ADMISSION_TENANT,
                 priority=BACKGROUND_PRIORITY)
+        # lint: disable=bare-except-at-seam -- best-effort warmer:
+        # it fails under exactly the backpressure it must not log-
+        # storm about; the review already answered from the stance
         except Exception:            # noqa: BLE001 — backpressure on
             pass                     # a best-effort warmer is fine
         finally:
