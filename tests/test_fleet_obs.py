@@ -1,0 +1,1334 @@
+"""Fleet observability plane (docs/observability.md "Fleet plane";
+``pytest -m fleetobs``).
+
+Cross-process trace propagation (the traceparent grammar, remote-
+parent root linking, the concurrent-root refcount, the RPC and
+webhook header folds, a 2-process simhost e2e proving ONE trace
+spans both processes), the mesh-wide timeline merge (per-host
+partition exactness under clock offsets, ``peer_straggler``
+attribution, the burn-down list), pairwise monotonic clock-offset
+estimation, and metrics/SLO federation (merged exposition under the
+bounded ``replica`` label, fleet burn rates byte-equal to a single
+union-fed engine, stale/unreachable peers, breaker-backed skip).
+"""
+
+import json
+import os
+import random
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_sched import make_fleet, make_store
+from trivy_tpu.obs.propagate import (EMPTY_CONTEXT, ClockClient,
+                                     ClockServer, TraceContext,
+                                     current_context,
+                                     estimate_offset, extract,
+                                     inject, parse_traceparent,
+                                     read_port_file)
+from trivy_tpu.obs.slo import (SLO, SloEngine, merge_exports,
+                               verdicts_from_export)
+from trivy_tpu.obs.timeline import (FLEET_CAUSES, MergedTimeline,
+                                    export_spans)
+from trivy_tpu.obs.trace import Tracer
+
+pytestmark = pytest.mark.fleetobs
+
+TID = "ab" * 16
+SID = "cd" * 8
+
+
+# ---------------------------------------------------------------
+# traceparent grammar
+# ---------------------------------------------------------------
+
+class TestTraceparent:
+    def test_round_trip(self):
+        ctx = TraceContext(trace_id=TID, parent_span_id=SID)
+        assert parse_traceparent(ctx.to_header()) == ctx
+
+    def test_header_shape(self):
+        h = TraceContext(trace_id=TID,
+                         parent_span_id=SID).to_header()
+        assert h == f"00-{TID}-{SID}-01"
+
+    def test_no_parent_renders_zero_span(self):
+        h = TraceContext(trace_id=TID).to_header()
+        version, tid, sid, flags = h.split("-")
+        assert sid == "0" * 16
+        # and parses back to the empty parent
+        assert parse_traceparent(h).parent_span_id == ""
+
+    @pytest.mark.parametrize("bad", [
+        "",
+        "garbage",
+        "00-abc",                                   # wrong arity
+        f"0-{TID}-{SID}-01",                        # short version
+        f"zz-{TID}-{SID}-01",                       # non-hex version
+        f"ff-{TID}-{SID}-01",                       # forbidden ff
+        f"00-{TID}-{SID}-1",                        # short flags
+        f"00-{TID}-{SID}-xx",                       # non-hex flags
+        f"00-{'0' * 32}-{SID}-01",                  # all-zero trace
+        f"00-{'XYZ' * 11}-{SID}-01",                # non-hex trace
+        f"00-{'a' * 7}-{SID}-01",                   # id too short
+        f"00-{'a' * 65}-{SID}-01",                  # id too long
+        f"00-{TID}-nothex!-01",                     # bad span id
+        "00-" + TID + "-" + SID + "-01-extra",      # trailing part
+    ])
+    def test_rejects(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_all_zero_span_id_means_root(self):
+        ctx = parse_traceparent(f"00-{TID}-{'0' * 16}-01")
+        assert ctx.trace_id == TID
+        assert ctx.parent_span_id == ""
+
+    def test_extract_precedence(self):
+        h = TraceContext(trace_id=TID,
+                         parent_span_id=SID).to_header()
+        other = TraceContext(trace_id="ef" * 16).to_header()
+        # body field wins over header
+        ctx = extract({"traceparent": h},
+                      headers={"Traceparent": other})
+        assert ctx.trace_id == TID
+        # header wins over legacy bare trace_id
+        ctx = extract({"trace_id": "99" * 8},
+                      headers={"Traceparent": h})
+        assert ctx.parent_span_id == SID
+        # legacy bare trace_id still honored
+        ctx = extract({"trace_id": "99" * 8})
+        assert ctx == TraceContext(trace_id="99" * 8)
+        # garbage everywhere -> the empty context, never None
+        assert extract({"traceparent": "junk"},
+                       headers={"Traceparent": "junk"}) \
+            == EMPTY_CONTEXT
+        assert extract("not a dict") == EMPTY_CONTEXT
+
+    def test_inject_requires_active_span(self):
+        body = {}
+        inject(body)
+        assert "traceparent" not in body
+        assert current_context() is None
+
+    def test_inject_from_active_span(self):
+        tracer = Tracer()
+        root = tracer.start_span("cli", trace_id=TID)
+        with root.activate():
+            ctx = current_context()
+            assert ctx.trace_id == TID
+            assert ctx.parent_span_id == root.span_id
+            body = {}
+            inject(body)
+            assert parse_traceparent(
+                body["traceparent"]).parent_span_id == root.span_id
+            assert body["trace_id"] == TID   # legacy field kept
+        root.end()
+
+
+# ---------------------------------------------------------------
+# remote-parent roots + the concurrent-root refcount
+# ---------------------------------------------------------------
+
+class TestRemoteParentRoots:
+    def test_remote_parent_links_but_stays_root(self):
+        tracer = Tracer()
+        root = tracer.start_span("simhost", trace_id=TID,
+                                 remote_parent=SID)
+        assert root.is_root
+        assert root.parent_id == SID
+        assert root.trace_id == TID
+        root.end()
+        spans = tracer.recorder.get(TID)
+        assert [s.span_id for s in spans] == [root.span_id]
+
+    def test_bad_remote_parent_dropped(self):
+        tracer = Tracer()
+        root = tracer.start_span("simhost", trace_id=TID,
+                                 remote_parent="NOT HEX")
+        assert root.parent_id is None
+        root.end()
+
+    def test_concurrent_roots_share_one_bucket(self):
+        tracer = Tracer()
+        r1 = tracer.start_request("a", trace_id=TID)
+        r2 = tracer.start_request("b", trace_id=TID,
+                                  parent_span_id=SID)
+        c1 = tracer.child(r1, "device")
+        c1.end()
+        r1.end()
+        # bucket must NOT complete while a sibling root is open
+        assert tracer.recorder.get(TID) is None
+        r2.end()
+        spans = tracer.recorder.get(TID)
+        assert spans is not None
+        assert {s.name for s in spans} == {"scan", "device"}
+        assert sum(1 for s in spans if s.is_root) == 2
+
+    def test_non_final_bad_root_marks_trace_dirty(self, tmp_path):
+        tracer = Tracer()
+        tracer.recorder.dump_dir = str(tmp_path)
+        r1 = tracer.start_request("a", trace_id=TID)
+        r2 = tracer.start_request("b", trace_id=TID)
+        r1.end(status="failed")          # non-final root goes bad
+        r2.end()                          # final root is fine
+        # the completed bucket still dumped: the failure evidence
+        # must not be lost because a healthy sibling finished last
+        assert os.path.exists(tracer.recorder.dump_path(TID))
+
+
+# ---------------------------------------------------------------
+# server-side propagation (header fold + child links)
+# ---------------------------------------------------------------
+
+def _scan_body(trace_kwargs):
+    body = {"target": "img", "artifact_id": "sha256:art",
+            "blob_ids": []}
+    body.update(trace_kwargs)
+    return body
+
+
+class TestServerPropagation:
+    @pytest.fixture()
+    def server(self):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        srv = ScanServer(sched="on")
+        httpd, _ = serve(port=0, server=srv)
+        yield srv, f"http://127.0.0.1:{httpd.server_address[1]}"
+        srv.close()
+        httpd.shutdown()
+
+    def _post(self, url, body, headers=None):
+        req = urllib.request.Request(
+            url + "/twirp/trivy.scanner.v1.Scanner/Scan",
+            data=json.dumps(body).encode(),
+            headers=dict({"Content-Type": "application/json"},
+                         **(headers or {})))
+        return urllib.request.urlopen(req, timeout=30)
+
+    def test_traceparent_header_roots_child(self, server):
+        srv, url = server
+        h = TraceContext(trace_id=TID,
+                         parent_span_id=SID).to_header()
+        assert self._post(url, _scan_body({}),
+                          {"Traceparent": h}).status == 200
+        spans = srv.tracer.recorder.get(TID)
+        assert spans is not None
+        roots = [s for s in spans if s.is_root]
+        assert roots and all(s.parent_id == SID for s in roots)
+
+    def test_body_traceparent_wins_over_header(self, server):
+        srv, url = server
+        body_h = TraceContext(trace_id=TID,
+                              parent_span_id=SID).to_header()
+        hdr_h = TraceContext(trace_id="ef" * 16).to_header()
+        self._post(url, _scan_body({"traceparent": body_h}),
+                   {"Traceparent": hdr_h})
+        assert srv.tracer.recorder.get(TID) is not None
+        assert srv.tracer.recorder.get("ef" * 16) is None
+
+    def test_legacy_trace_id_still_roots(self, server):
+        srv, url = server
+        self._post(url, _scan_body({"trace_id": TID}))
+        spans = srv.tracer.recorder.get(TID)
+        assert spans is not None
+        assert all(s.parent_id is None
+                   for s in spans if s.is_root)
+
+    def test_remote_scanner_injects_active_context(self,
+                                                   monkeypatch):
+        from trivy_tpu.rpc.client import RemoteScanner
+        from trivy_tpu.obs.trace import get_tracer
+        sent = {}
+
+        def fake_call(self, path, body, deadline_s=0.0):
+            sent.update(body)
+            return {"results": [], "os": None, "eosl": False}
+
+        monkeypatch.setattr(RemoteScanner, "call", fake_call)
+        tracer = get_tracer()
+        root = tracer.start_span("cli", trace_id=TID)
+        with root.activate():
+            sc = RemoteScanner("http://x")
+            from trivy_tpu.scan.local import ScanTarget
+            from trivy_tpu.types import ScanOptions
+            sc.scan(ScanTarget(name="i", artifact_id="a",
+                               blob_ids=[]), ScanOptions())
+        root.end()
+        ctx = parse_traceparent(sent["traceparent"])
+        assert ctx.trace_id == TID
+        assert ctx.parent_span_id == root.span_id
+        assert sent["trace_id"] == TID
+        assert sc.last_trace_id == TID
+
+
+# ---------------------------------------------------------------
+# watch seam: traceparent on the notification envelope
+# ---------------------------------------------------------------
+
+class TestWatchPropagation:
+    def test_envelope_traceparent_rides_events(self):
+        from trivy_tpu.watch.source import parse_notification
+        h = TraceContext(trace_id=TID,
+                         parent_span_id=SID).to_header()
+        body = {"traceparent": h, "events": [
+            {"action": "push", "target": {
+                "repository": "lib/app", "tag": "1",
+                "digest": "sha256:" + "a" * 64}}]}
+        events, malformed = parse_notification(body)
+        assert not malformed and len(events) == 1
+        assert events[0].traceparent == h
+
+    def test_watch_submit_passes_context(self, tmp_path):
+        from trivy_tpu.watch import WatchConfig, WatchLoop
+        from trivy_tpu.watch.source import PushEvent
+
+        class Source:
+            def pull(self, max_events):
+                return []
+
+            def close(self):
+                pass
+
+        calls = []
+
+        class Runner:
+            def submit_path(self, path, options, **kw):
+                calls.append(kw)
+
+                class Req:
+                    def done(self):
+                        return True
+
+                    status = "ok"
+                return Req()
+
+        loop = WatchLoop(Runner(), Source(), WatchConfig())
+        h = TraceContext(trace_id=TID,
+                         parent_span_id=SID).to_header()
+        ev = PushEvent(digest="sha256:" + "a" * 64, ref="r",
+                       path=str(tmp_path / "x.tar"),
+                       traceparent=h)
+        # drive the private submit directly with a minimal group
+        from trivy_tpu.watch.loop import _Group
+        loop._submit(_Group(ev))
+        assert calls and calls[0]["trace_id"] == TID
+        assert calls[0]["parent_span_id"] == SID
+
+    def test_garbage_traceparent_is_fresh_trace(self, tmp_path):
+        from trivy_tpu.watch import WatchConfig, WatchLoop
+        from trivy_tpu.watch.loop import _Group
+        from trivy_tpu.watch.source import PushEvent
+        calls = []
+
+        class Runner:
+            def submit_path(self, path, options, **kw):
+                calls.append(kw)
+
+                class Req:
+                    def done(self):
+                        return True
+
+                    status = "ok"
+                return Req()
+
+        loop = WatchLoop(Runner(), None, WatchConfig())
+        loop._submit(_Group(PushEvent(
+            digest="d", path=str(tmp_path / "x.tar"),
+            traceparent="complete garbage")))
+        assert calls[0]["trace_id"] == ""
+        assert calls[0]["parent_span_id"] == ""
+
+
+# ---------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------
+
+class TestClockOffset:
+    def test_skewed_probe_within_bound(self):
+        skew = 42.5
+
+        def probe():
+            return time.monotonic() + skew
+
+        est = estimate_offset(probe, samples=6)
+        # local = remote + offset  ->  offset ≈ -skew
+        assert est.samples == 6
+        assert abs(est.offset_s + skew) <= est.error_bound_s + 1e-3
+
+    def test_clock_server_round_trip(self, tmp_path):
+        srv = ClockServer()
+        try:
+            port_file = str(tmp_path / "clock.port")
+            srv.write_port_file(port_file)
+            assert read_port_file(port_file) == srv.port
+            cli = ClockClient("127.0.0.1", srv.port)
+            est = estimate_offset(cli.probe, samples=4)
+            cli.close()
+            # same Linux CLOCK_MONOTONIC: |estimate| IS the error
+            assert abs(est.offset_s) <= est.error_bound_s + 0.05
+            assert srv.requests >= 4
+        finally:
+            srv.close()
+        srv.close()        # idempotent
+
+    def test_read_port_file_times_out(self, tmp_path):
+        with pytest.raises(TimeoutError):
+            read_port_file(str(tmp_path / "never.port"),
+                           timeout_s=0.2)
+
+
+# ---------------------------------------------------------------
+# merged timeline: partition exactness + peer_straggler
+# ---------------------------------------------------------------
+
+def _mk_span(name, tid, sid, pid, a, b, root=False):
+    class S:
+        noop = False
+        events = ()
+        status = "ok"
+    s = S()
+    s.name, s.trace_id, s.span_id, s.parent_id = name, tid, sid, pid
+    s.start_mono, s.end_mono = a, b
+    s.attrs = {}
+    s.is_root = root
+    return s
+
+
+def _seeded_host(rng, host, base):
+    """One host's plausible span soup: a root window, device
+    compute bursts, host phases — seeded, no wall clock."""
+    tid = f"{rng.getrandbits(64):016x}"
+    t0 = base + rng.uniform(0, 2)
+    t1 = t0 + rng.uniform(4, 10)
+    spans = [_mk_span("scan", tid, f"{host}root", None, t0, t1,
+                      root=True)]
+    t = t0
+    i = 0
+    while t < t1 - 0.5:
+        width = rng.uniform(0.2, 1.0)
+        name = rng.choice(["device_compute", "pack", "decode",
+                           "device", "h2d_upload"])
+        end = min(t + width, t1)
+        spans.append(_mk_span(name, tid, f"{host}s{i}", f"{host}root",
+                              t, end))
+        t = end + rng.uniform(0.0, 0.8)
+        i += 1
+    return spans
+
+
+class TestMergedTimeline:
+    @pytest.mark.parametrize("seed", [7, 21, 1999])
+    def test_partition_exactness_property(self, seed):
+        rng = random.Random(seed)
+        exports = []
+        for h in range(3):
+            spans = _seeded_host(rng, f"h{h}", base=100.0 * h)
+            exports.append(export_spans(spans, process=f"h{h}",
+                                        epoch_mono=100.0 * h))
+        offsets = [0.0, -100.0, -200.0]
+        mt = MergedTimeline(exports, offsets=offsets)
+        rep = mt.report()
+        for host in rep["hosts"]:
+            attr = host["attribution"]
+            assert set(attr) == set(FLEET_CAUSES)
+            assert all(v >= 0 for v in attr.values()), attr
+            # report() rounds to 1µs per cause; exactness holds to
+            # the rounding granularity times the cause count
+            assert sum(attr.values()) == \
+                pytest.approx(host["idle_s"], abs=1e-4)
+        fleet = rep["fleet"]
+        assert sum(fleet["attribution"].values()) == \
+            pytest.approx(fleet["idle_s"], abs=1e-4)
+
+    def test_peer_straggler_carved_from_local_idle(self):
+        # host0 finishes at 4.5; host1 computes until 8 -> host0's
+        # queue_empty tail overlapped by host1 busy becomes
+        # peer_straggler, exactly
+        e0 = export_spans(
+            [_mk_span("scan", "aa" * 8, "r0", None, 0.0, 4.5,
+                      root=True),
+             _mk_span("device_compute", "aa" * 8, "c0", "r0",
+                      1.0, 4.0)], process="host0")
+        e1 = export_spans(
+            [_mk_span("scan", "bb" * 8, "r1", None, 0.0, 9.0,
+                      root=True),
+             _mk_span("device_compute", "bb" * 8, "c1", "r1",
+                      1.0, 8.0)], process="host1")
+        mt = MergedTimeline([e0, e1])
+        h0 = mt.report()["hosts"][0]
+        # host0's peer-eligible idle (the 4.0-4.5 drain gap plus
+        # the 4.5-9 after-root window) overlaps host1's compute on
+        # exactly 4.0-8.0; the 8-9 tail stays a local cause
+        assert h0["attribution"]["peer_straggler"] == \
+            pytest.approx(4.0, abs=1e-5)
+        assert sum(h0["attribution"].values()) == \
+            pytest.approx(h0["idle_s"], abs=1e-4)
+
+    def test_local_causes_never_reattributed(self):
+        # host0's upload gap stays upload_serialized even while
+        # host1 is busy: only queue_empty/unknown are eligible
+        e0 = export_spans(
+            [_mk_span("scan", "aa" * 8, "r0", None, 0.0, 4.0,
+                      root=True),
+             _mk_span("h2d_upload", "aa" * 8, "u0", "r0", 1.0, 3.0)],
+            process="host0")
+        e1 = export_spans(
+            [_mk_span("scan", "bb" * 8, "r1", None, 0.0, 4.0,
+                      root=True),
+             _mk_span("device_compute", "bb" * 8, "c1", "r1",
+                      0.0, 4.0)], process="host1")
+        mt = MergedTimeline([e0, e1])
+        h0 = mt.report()["hosts"][0]
+        assert h0["attribution"]["upload_serialized"] == \
+            pytest.approx(2.0, abs=1e-6)
+
+    def test_offset_alignment_shifts_attribution(self):
+        # host1's spans live on a clock 1000s ahead; with the right
+        # offset they align and overlap host0's idle
+        e0 = export_spans(
+            [_mk_span("scan", "aa" * 8, "r0", None, 0.0, 2.0,
+                      root=True),
+             _mk_span("device_compute", "aa" * 8, "c0", "r0",
+                      0.0, 1.0)], process="host0")
+        e1 = export_spans(
+            [_mk_span("scan", "bb" * 8, "r1", None, 1000.0, 1003.0,
+                      root=True),
+             _mk_span("device_compute", "bb" * 8, "c1", "r1",
+                      1000.0, 1003.0)], process="host1")
+        aligned = MergedTimeline([e0, e1], offsets=[0.0, -1000.0])
+        rep = aligned.report()
+        # aligned axis: a 3s fleet window; host0's drain gap (1-2)
+        # and after-root tail (2-3) both overlap host1's compute
+        assert rep["window_s"] == pytest.approx(3.0, abs=1e-5)
+        h0 = rep["hosts"][0]
+        assert h0["attribution"]["peer_straggler"] == \
+            pytest.approx(2.0, abs=1e-4)
+        # without the offset the axis inflates to the raw 1003s
+        # span and host0 looks idle for ~1000s
+        raw = MergedTimeline([e0, e1]).report()
+        assert raw["window_s"] > 1000.0
+        assert raw["hosts"][0]["idle_s"] > 100.0
+
+    def test_burn_down_sorted_latest_first(self):
+        exports = []
+        for i, end in enumerate([3.0, 9.0, 6.0]):
+            exports.append(export_spans(
+                [_mk_span("scan", f"{'%02d' % i}" * 8, "r", None,
+                          0.0, end, root=True),
+                 _mk_span("device_compute", f"{'%02d' % i}" * 8,
+                          "c", "r", 0.0, end)],
+                process=f"host{i}"))
+        rep = MergedTimeline(exports).report()
+        order = [h["process"] for h in rep["burn_down"]]
+        assert order == ["host1", "host2", "host0"]
+        assert rep["burn_down"][0]["finished_at_s"] == \
+            pytest.approx(9.0, abs=1e-6)
+
+    def test_empty_exports(self):
+        mt = MergedTimeline([])
+        rep = mt.report()
+        assert rep["hosts"] == []
+        assert rep["window_s"] == 0.0
+
+
+# ---------------------------------------------------------------
+# SLO federation: byte-equality against a union-fed engine
+# ---------------------------------------------------------------
+
+def _engines():
+    slos = [SLO(name="avail", objective=0.99),
+            SLO(name="lat", kind="latency", objective=0.95,
+                threshold_s=0.5)]
+    return SloEngine(list(slos)), SloEngine(list(slos)), \
+        SloEngine(list(slos))
+
+
+class TestSloFederation:
+    def test_merged_verdicts_byte_equal_union(self):
+        a, b, union = _engines()
+        for i in range(60):
+            out = "ok" if i % 9 else "failed"
+            lat = 0.1 if i % 7 else 0.8
+            tid = f"{i:032x}" if out == "failed" else ""
+            a.record(out, latency_s=lat, trace_id=tid)
+            union.record(out, latency_s=lat, trace_id=tid)
+        for i in range(40):
+            out = "ok" if i % 5 else "timed_out"
+            b.record(out, latency_s=0.2)
+            union.record(out, latency_s=0.2)
+        now = time.monotonic()
+        merged = merge_exports([a.export_state(now=now),
+                                b.export_state(now=now)])
+        fed = verdicts_from_export(merged, now=now)
+        one = verdicts_from_export(union.export_state(now=now),
+                                   now=now)
+        assert json.dumps(fed, sort_keys=True) == \
+            json.dumps(one, sort_keys=True)
+
+    def test_merge_sums_by_age_and_caps_exemplars(self):
+        export = {"bucket_s": 10.0, "slos": [{
+            "slo": {"name": "s", "kind": "availability",
+                    "objective": 0.99},
+            "good": 5, "bad": 2,
+            "buckets": [[0, 5, 2]],
+            "exemplar_trace_ids": [f"{i:08x}" for i in range(6)],
+        }]}
+        merged = merge_exports([export, json.loads(
+            json.dumps(export))])
+        entry = merged["slos"][0]
+        assert entry["good"] == 10 and entry["bad"] == 4
+        assert entry["buckets"] == [[0, 10, 4]]
+        # dedup: both replicas carried the same ids
+        assert entry["exemplar_trace_ids"] == \
+            [f"{i:08x}" for i in range(6)]
+
+    def test_empty_and_malformed_exports_ignored(self):
+        merged = merge_exports([None, {}, {"slos": "nope"},
+                                {"slos": [{"slo": {}}]}])
+        assert merged["slos"] == []
+        assert verdicts_from_export(merged) == []
+        assert verdicts_from_export({}) == []
+
+    def test_first_definition_wins(self):
+        e1 = {"slos": [{"slo": {"name": "s", "objective": 0.999},
+                        "good": 1, "bad": 0, "buckets": []}]}
+        e2 = {"slos": [{"slo": {"name": "s", "objective": 0.5},
+                        "good": 1, "bad": 0, "buckets": []}]}
+        merged = merge_exports([e1, e2])
+        assert merged["slos"][0]["slo"]["objective"] == 0.999
+
+
+# ---------------------------------------------------------------
+# the Federator: staleness, breakers, cardinality
+# ---------------------------------------------------------------
+
+def _snap(name="peer", engine=None):
+    return {"name": name, "build_info": {"version": "t"},
+            "prom": "# TYPE up gauge\nup 1\n",
+            "slo_export": (engine.export_state() if engine
+                           else {"bucket_s": 10.0, "slos": []}),
+            "mono": 0.0}
+
+
+class TestFederator:
+    def _fed(self, fetch, peers=None, **kw):
+        from trivy_tpu.obs.federate import Federator
+        return Federator(peers or [("p1", "http://a"),
+                                   ("p2", "http://b")],
+                         fetch=fetch, **kw)
+
+    def test_unreachable_peer_marked_never_raises(self):
+        def fetch(url):
+            if url.endswith("b"):
+                raise OSError("connection refused")
+            return _snap("p1")
+
+        fed = self._fed(fetch)
+        rows = fed.collect()
+        assert [r["up"] for r in rows] == [True, False]
+        assert rows[1]["stale"] is True
+        assert "refused" in rows[1]["error"]
+        fleet = fed.fleet_slo({}, rows)
+        assert fleet["complete"] is False
+        # the exposition still renders, carrying the peer_up gauges
+        text = fed.render("front", "# TYPE l gauge\nl 1\n", rows,
+                          fleet=fleet)
+        assert 'trivy_tpu_federate_peer_up{replica="p2"} 0' in text
+        assert "trivy_tpu_fleet_complete 0" in text
+
+    def test_last_snapshot_kept_until_stale(self):
+        clock = [0.0]
+        healthy = [True]
+
+        def fetch(url):
+            if not healthy[0]:
+                raise OSError("down")
+            return _snap("p1")
+
+        fed = self._fed(fetch, peers=[("p1", "http://a")],
+                        stale_after_s=30.0,
+                        clock=lambda: clock[0])
+        rows = fed.collect()
+        assert rows[0]["up"] and not rows[0]["stale"]
+        healthy[0] = False
+        clock[0] = 10.0
+        rows = fed.collect()
+        # down but recent: snapshot still served, not yet stale
+        assert not rows[0]["up"] and not rows[0]["stale"]
+        assert rows[0]["snapshot"] is not None
+        clock[0] = 100.0
+        rows = fed.collect()
+        assert rows[0]["stale"] is True
+
+    def test_breaker_skips_after_threshold(self):
+        calls = []
+
+        def fetch(url):
+            calls.append(url)
+            raise OSError("down")
+
+        fed = self._fed(fetch, peers=[("p1", "http://a")],
+                        fail_threshold=2, cooldown_s=3600.0)
+        for _ in range(4):
+            rows = fed.collect()
+        # 2 real attempts tripped the breaker; later scrapes skip
+        assert len(calls) == 2
+        assert rows[0]["skipped"] is True
+        assert rows[0]["breaker"] == "open"
+        assert fed.stats()["per_peer"][0]["skips"] >= 1
+
+    def test_replica_cardinality_fold(self):
+        from trivy_tpu.obs.federate import MAX_REPLICAS
+        peers = [(f"p{i}", f"http://h{i}")
+                 for i in range(MAX_REPLICAS + 5)]
+        fed = self._fed(lambda url: _snap(), peers=peers)
+        names = {p.name for p in fed.peers}
+        assert "other" in names
+        assert len(names) == MAX_REPLICAS + 1
+
+    def test_parse_peers_grammar(self):
+        from trivy_tpu.obs.federate import parse_peers
+        assert parse_peers("a=http://h1:1,http://h2:2") == \
+            [("a", "http://h1:1"), ("h2:2", "http://h2:2")]
+        # already-parsed pairs pass through
+        assert parse_peers([("p1", "http://a:1")]) == \
+            [("p1", "http://a:1")]
+        for bad in ("=:::", "x=ftp://nope", "justaname=",
+                    "name=not a url"):
+            with pytest.raises(ValueError):
+                parse_peers(bad)
+
+    def test_replica_label_sanitized(self):
+        from trivy_tpu.obs.federate import _clean_replica
+        cleaned = _clean_replica('evil"le} 1\n')
+        assert not set(cleaned) & set('"\\{}\n ')
+        assert cleaned.startswith("evil")
+        assert _clean_replica("") == "other"
+        assert len(_clean_replica("x" * 200)) <= 64
+
+    def test_merged_exposition_groups_families(self):
+        from trivy_tpu.obs.federate import merge_expositions
+        parts = [
+            ("a", "# HELP m c\n# TYPE m counter\nm 1\n"
+                  "# TYPE h histogram\nh_bucket{le=\"+Inf\"} 1\n"
+                  "h_sum 0.5\nh_count 1\n"),
+            ("b", "# TYPE m counter\nm 2\n"
+                  "# TYPE h histogram\nh_bucket{le=\"+Inf\"} 2\n"
+                  "h_sum 1.0\nh_count 2\n"),
+        ]
+        text = merge_expositions(parts)
+        lines = text.splitlines()
+        # families contiguous: both m samples before any h line
+        m_idx = [i for i, ln in enumerate(lines)
+                 if ln.startswith("m{")]
+        h_idx = [i for i, ln in enumerate(lines)
+                 if ln.startswith("h_")]
+        assert max(m_idx) < min(h_idx)
+        # TYPE emitted once per family
+        assert sum(1 for ln in lines
+                   if ln.startswith("# TYPE m ")) == 1
+        assert 'm{replica="a"} 1' in lines
+        assert 'm{replica="b"} 2' in lines
+        # histogram series keep their le labels under the replica
+        assert 'h_bucket{replica="b",le="+Inf"} 2' in lines
+
+    def test_existing_replica_label_passes_through(self):
+        from trivy_tpu.obs.federate import _inject_replica
+        line = 'up{replica="deep"} 1'
+        assert _inject_replica(line, "front") == line
+
+
+# ---------------------------------------------------------------
+# federation over HTTP: snapshot route + federate route
+# ---------------------------------------------------------------
+
+class TestFederationHTTP:
+    def _get(self, url, path, token="s3cret", accept=None):
+        req = urllib.request.Request(url + path)
+        if token:
+            req.add_header("Trivy-Token", token)
+        if accept:
+            req.add_header("Accept", accept)
+        return urllib.request.urlopen(req, timeout=30)
+
+    def test_snapshot_and_federate_e2e(self):
+        from trivy_tpu.obs.federate import Federator
+        from trivy_tpu.rpc.server import ScanServer, serve
+        peer = ScanServer(token="s3cret")
+        p_httpd, _ = serve(port=0, server=peer)
+        p_url = f"http://127.0.0.1:{p_httpd.server_address[1]}"
+        front = ScanServer(
+            token="s3cret", replica_name="front",
+            federator=Federator([("peerA", p_url)],
+                                token="s3cret"))
+        f_httpd, _ = serve(port=0, server=front)
+        f_url = f"http://127.0.0.1:{f_httpd.server_address[1]}"
+        try:
+            snap = json.load(self._get(p_url, "/metrics/snapshot"))
+            assert {"name", "build_info", "prom", "slo_export",
+                    "mono"} <= set(snap)
+            text = self._get(f_url,
+                             "/metrics/federate").read().decode()
+            assert 'replica="front"' in text
+            assert 'replica="peerA"' in text
+            assert 'trivy_tpu_federate_peer_up{replica="peerA"} 1' \
+                in text
+            assert "trivy_tpu_fleet_complete 1" in text
+            # /slo gains the fleet section
+            slo = json.load(self._get(f_url, "/slo"))
+            assert slo["fleet"]["complete"] is True
+            assert isinstance(slo["fleet"]["slo_ok"], bool)
+            # snapshot and federate honor the token
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(p_url, "/metrics/snapshot", token=None)
+            assert ei.value.code == 401
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(f_url, "/metrics/federate", token=None)
+            assert ei.value.code == 401
+        finally:
+            front.close()
+            peer.close()
+            f_httpd.shutdown()
+            p_httpd.shutdown()
+
+    def test_federate_404_without_peers(self):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        srv = ScanServer()
+        httpd, _ = serve(port=0, server=srv)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(url, "/metrics/federate", token=None)
+            assert ei.value.code == 404
+        finally:
+            srv.close()
+            httpd.shutdown()
+
+    def test_clock_route(self):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        srv = ScanServer(token="s3cret")
+        httpd, _ = serve(port=0, server=srv)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            doc = json.load(self._get(url, "/clock"))
+            assert doc["mono"] > 0
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(url, "/clock", token=None)
+            assert ei.value.code == 401
+        finally:
+            srv.close()
+            httpd.shutdown()
+
+    def test_dead_peer_partial_federation(self):
+        from trivy_tpu.obs.federate import Federator
+        from trivy_tpu.rpc.server import ScanServer, serve
+        front = ScanServer(
+            replica_name="front",
+            federator=Federator(
+                [("ghost", "http://127.0.0.1:9")],
+                timeout_s=0.3))
+        httpd, _ = serve(port=0, server=front)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            text = self._get(url, "/metrics/federate",
+                             token=None).read().decode()
+            assert 'trivy_tpu_federate_peer_up{replica="ghost"} 0' \
+                in text
+            assert 'trivy_tpu_federate_peer_stale' \
+                '{replica="ghost"} 1' in text
+            assert "trivy_tpu_fleet_complete 0" in text
+            assert 'replica="front"' in text
+        finally:
+            front.close()
+            httpd.shutdown()
+
+
+# ---------------------------------------------------------------
+# build info + recorder dump hygiene satellites
+# ---------------------------------------------------------------
+
+class TestBuildInfo:
+    @pytest.mark.parametrize("sched", ["on", "off"])
+    def test_gauge_on_metrics_both_modes(self, sched):
+        from trivy_tpu.rpc.server import ScanServer
+        srv = ScanServer(sched=(sched if sched == "on" else None))
+        try:
+            text = srv.metrics_text()
+            line = [ln for ln in text.splitlines()
+                    if ln.startswith("trivy_tpu_build_info{")]
+            assert len(line) == 1
+            assert f'sched="{sched}"' in line[0]
+            assert 'version="' in line[0]
+            assert 'jax_version="' in line[0]
+            assert line[0].endswith(" 1")
+            info = srv.build_info()
+            assert info["sched"] == sched
+        finally:
+            srv.close()
+
+    def test_healthz_mirrors_build(self):
+        from trivy_tpu.rpc.server import ScanServer, serve
+        srv = ScanServer(token="s3cret")
+        httpd, _ = serve(port=0, server=srv)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            doc = json.load(urllib.request.urlopen(
+                url + "/healthz", timeout=10))   # token-free
+            assert doc["build"]["version"]
+            assert doc["build"]["sched"] == "off"
+        finally:
+            srv.close()
+            httpd.shutdown()
+
+
+class TestRecorderDumpHygiene:
+    def _dump_n(self, rec, n, start=0):
+        for i in range(start, start + n):
+            tid = f"{i:032x}"
+            rec.add(tid, [])
+            rec.dump(tid)
+
+    def test_dump_bytes_tracked(self, tmp_path):
+        from trivy_tpu.obs.recorder import FlightRecorder
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        self._dump_n(rec, 3)
+        st = rec.stats()
+        assert st["dump_files"] == 3
+        disk = sum(os.path.getsize(os.path.join(tmp_path, f))
+                   for f in os.listdir(tmp_path))
+        assert st["dump_bytes"] == disk > 0
+
+    def test_redump_does_not_double_count(self, tmp_path):
+        from trivy_tpu.obs.recorder import FlightRecorder
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        tid = "ee" * 16
+        rec.add(tid, [])
+        rec.dump(tid)
+        first = rec.stats()["dump_bytes"]
+        rec.dump(tid)
+        assert rec.stats()["dump_files"] == 1
+        assert rec.stats()["dump_bytes"] == \
+            os.path.getsize(rec.dump_path(tid))
+        assert abs(rec.stats()["dump_bytes"] - first) <= first
+
+    def test_age_pruning_via_env(self, tmp_path, monkeypatch):
+        from trivy_tpu.obs.recorder import (DUMP_MAX_AGE_ENV,
+                                            FlightRecorder)
+        monkeypatch.setenv(DUMP_MAX_AGE_ENV, "100")
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        clock = [0.0]
+        rec._clock = lambda: clock[0]
+        self._dump_n(rec, 2)
+        clock[0] = 200.0                    # first two now too old
+        self._dump_n(rec, 1, start=2)
+        st = rec.stats()
+        assert st["dumps_pruned"] == 2
+        assert st["dump_files"] == 1
+        assert len(os.listdir(tmp_path)) == 1
+        assert st["dump_bytes"] == sum(
+            os.path.getsize(os.path.join(tmp_path, f))
+            for f in os.listdir(tmp_path))
+
+    def test_age_pruning_off_by_default(self, tmp_path,
+                                        monkeypatch):
+        from trivy_tpu.obs.recorder import (DUMP_MAX_AGE_ENV,
+                                            FlightRecorder)
+        monkeypatch.delenv(DUMP_MAX_AGE_ENV, raising=False)
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        clock = [0.0]
+        rec._clock = lambda: clock[0]
+        self._dump_n(rec, 2)
+        clock[0] = 1e9
+        self._dump_n(rec, 1, start=2)
+        assert rec.stats()["dumps_pruned"] == 0
+        assert rec.stats()["dump_files"] == 3
+
+    def test_cap_pruning_updates_bytes(self, tmp_path,
+                                       monkeypatch):
+        from trivy_tpu.obs.recorder import FlightRecorder
+        monkeypatch.setattr(FlightRecorder, "DUMP_CAP", 4)
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        self._dump_n(rec, 7)
+        st = rec.stats()
+        assert st["dump_files"] == 4
+        assert st["dumps_pruned"] == 3
+        assert len(os.listdir(tmp_path)) == 4
+        assert st["dump_bytes"] == sum(
+            os.path.getsize(os.path.join(tmp_path, f))
+            for f in os.listdir(tmp_path))
+
+    def test_gauges_on_exposition(self, tmp_path):
+        from trivy_tpu.obs import render_prometheus
+        from trivy_tpu.obs.recorder import FlightRecorder
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        self._dump_n(rec, 2)
+        text = render_prometheus({"counters": {"completed": 1}},
+                                 recorder_stats=rec.stats())
+        assert re.search(
+            r"trivy_tpu_recorder_dump_bytes \d+", text)
+        assert "trivy_tpu_recorder_dumps_pruned_total 0" in text
+
+
+# ---------------------------------------------------------------
+# propagation on vs off: findings byte-identity
+# ---------------------------------------------------------------
+
+class TestByteIdentity:
+    def test_ambient_trace_does_not_change_findings(self, tmp_path):
+        from trivy_tpu.runtime import BatchScanRunner
+        from trivy_tpu.obs.trace import get_tracer
+        from tests.test_sched import _norm
+        paths = make_fleet(tmp_path, 3)
+
+        def run(ambient):
+            runner = BatchScanRunner(store=make_store(),
+                                     backend="cpu-ref",
+                                     sched="off")
+            try:
+                if ambient:
+                    tracer = get_tracer()
+                    root = tracer.start_span("fleet",
+                                             trace_id="fa" * 16)
+                    with root.activate():
+                        res = runner.scan_paths(list(paths))
+                    root.end()
+                else:
+                    res = runner.scan_paths(list(paths))
+            finally:
+                runner.close()
+            return _norm(res)
+
+        assert run(False) == run(True)
+
+    def test_ambient_span_links_scan_roots(self, tmp_path):
+        from trivy_tpu.runtime import BatchScanRunner
+        from trivy_tpu.obs.trace import get_tracer
+        paths = make_fleet(tmp_path, 2)
+        tracer = get_tracer()
+        root = tracer.start_span("fleet", trace_id="fb" * 16)
+        runner = BatchScanRunner(store=make_store(),
+                                 backend="cpu-ref", sched="off")
+        try:
+            with root.activate():
+                runner.scan_paths(list(paths))
+        finally:
+            runner.close()
+        root.end()
+        spans = tracer.recorder.get("fb" * 16)
+        assert spans is not None
+        scan_roots = [s for s in spans
+                      if s.is_root and s.name == "scan"]
+        assert len(scan_roots) == 2
+        assert all(s.parent_id == root.span_id
+                   for s in scan_roots)
+
+
+# ---------------------------------------------------------------
+# 2-process simhost e2e: one trace, merged timeline
+# ---------------------------------------------------------------
+
+FIXTURE_DB = {"alpine 3.16": {"pkg1": {
+    "CVE-2099-0001": {"FixedVersion": "2.0.0-r0"}}}}
+FIXTURE_VULNS = {"CVE-2099-0001": {"Severity": "HIGH"}}
+
+
+class TestSimhostFleetTrace:
+    def test_two_hosts_one_trace_and_merged_timeline(self,
+                                                     tmp_path):
+        from trivy_tpu.obs.trace import get_tracer
+        tracer = get_tracer()
+        root = tracer.start_span("fleet", trace_id="dd" * 16)
+        paths = make_fleet(tmp_path, 4)
+        procs = []
+        for pid in range(2):
+            spec = {"paths": paths, "devices": 1,
+                    "dispatch_depth": 2,
+                    "db_fixture": FIXTURE_DB,
+                    "vulns": FIXTURE_VULNS,
+                    "traceparent": TraceContext(
+                        trace_id=root.trace_id,
+                        parent_span_id=root.span_id).to_header(),
+                    "clock_port_file":
+                        str(tmp_path / f"clock{pid}.port")}
+            spec_path = str(tmp_path / f"spec{pid}.json")
+            with open(spec_path, "w", encoding="utf-8") as f:
+                json.dump(spec, f)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       TRIVY_TPU_NUM_PROCESSES="2",
+                       TRIVY_TPU_PROCESS_ID=str(pid),
+                       TRIVY_TPU_COORDINATOR="sim:0")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "trivy_tpu.parallel.simhost", spec_path,
+                 str(tmp_path / f"out{pid}.json")],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE))
+
+        # pairwise clock handshake WHILE the hosts scan
+        offsets = []
+        for pid in range(2):
+            port = read_port_file(
+                str(tmp_path / f"clock{pid}.port"), timeout_s=120)
+            cli = ClockClient("127.0.0.1", port)
+            est = estimate_offset(cli.probe, samples=6)
+            cli.close()
+            # shared CLOCK_MONOTONIC: the estimate's magnitude IS
+            # its error, and must respect the advertised bound
+            assert abs(est.offset_s) <= est.error_bound_s + 0.05
+            offsets.append(est.offset_s)
+
+        outs = []
+        for pid, proc in enumerate(procs):
+            _, err = proc.communicate(timeout=300)
+            assert proc.returncode == 0, err[-2000:].decode()
+            with open(tmp_path / f"out{pid}.json",
+                      encoding="utf-8") as f:
+                outs.append(json.load(f))
+        root.end()
+
+        # ONE trace spans both processes: every host's root carries
+        # the parent's span id and the parent's trace id
+        for o in outs:
+            assert o["trace"]["trace_id"] == root.trace_id
+            assert o["trace"]["remote_parent"] == root.span_id
+            exported = o["timeline"]["spans"]
+            host_root = [s for s in exported
+                         if s["span_id"] ==
+                         o["trace"]["root_span_id"]]
+            assert host_root
+            assert host_root[0]["parent_id"] == root.span_id
+            assert host_root[0]["is_root"] is True
+            # child links resolve: every non-root parent exists
+            ids = {s["span_id"] for s in exported}
+            for s in exported:
+                if s["parent_id"] and not s["is_root"]:
+                    assert s["parent_id"] in ids
+
+        # the parent's own recorder has the fleet root under the
+        # same id — a dump on the parent names the whole trace
+        assert tracer.recorder.get(root.trace_id) is not None
+
+        # merged timeline: exactness survives the merge
+        mt = MergedTimeline([o["timeline"] for o in outs],
+                            offsets=offsets)
+        rep = mt.report()
+        assert len(rep["hosts"]) == 2
+        for host in rep["hosts"]:
+            assert sum(host["attribution"].values()) == \
+                pytest.approx(host["idle_s"], abs=1e-5)
+        assert rep["fleet"]["coverage"] >= 0.5
+        assert len(rep["burn_down"]) == 2
+        finished = [h["finished_at_s"] for h in rep["burn_down"]]
+        assert finished == sorted(finished, reverse=True)
+
+
+# ---------------------------------------------------------------
+# strict exposition-format round-trip parser
+# ---------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ #]+)"
+    r"(?P<exemplar> # \{.*\} [^ ]+(?: [^ ]+)?)?$")
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _unescape(v):
+    return (v.replace('\\"', '"').replace("\\n", "\n")
+            .replace("\\\\", "\\"))
+
+
+def _base_family(name, families):
+    for suf in ("_bucket", "_sum", "_count"):
+        if name.endswith(suf) and name[:-len(suf)] in families:
+            return name[:-len(suf)]
+    return name
+
+
+def strict_parse(text, openmetrics):
+    """Parse a full exposition STRICTLY: every line must match the
+    grammar, TYPE must precede its samples, histograms must be
+    cumulative and +Inf-terminated, exemplars only in openmetrics
+    mode, ``# EOF`` exactly at the end of openmetrics output.
+    Returns {family: {"type", "help", "samples": [(name, labels,
+    value)]}} with labels as a sorted tuple of (k, v)."""
+    families = {}
+    lines = text.split("\n")
+    assert lines[-1] == "", "exposition must end with a newline"
+    lines = lines[:-1]
+    if openmetrics:
+        assert lines[-1] == "# EOF", "openmetrics must end # EOF"
+        lines = lines[:-1]
+    for ln in lines:
+        assert ln == ln.strip(), f"stray whitespace: {ln!r}"
+        assert "# EOF" not in ln, f"EOF not at end: {ln!r}"
+        if ln.startswith("# HELP "):
+            _, _, rest = ln.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            assert _NAME_RE.match(name), ln
+            assert name not in families, f"duplicate HELP {name}"
+            families[name] = {"type": None, "help": help_,
+                              "samples": []}
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, rest = ln.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            assert mtype in _TYPES, ln
+            assert name in families, f"TYPE before HELP: {ln}"
+            assert families[name]["type"] is None, \
+                f"duplicate TYPE {name}"
+            assert not families[name]["samples"], \
+                f"TYPE after samples: {name}"
+            families[name]["type"] = mtype
+            continue
+        assert not ln.startswith("#"), f"unknown comment: {ln!r}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        if m.group("exemplar"):
+            assert openmetrics, f"exemplar in 0.0.4 text: {ln!r}"
+            assert m.group("name").endswith("_bucket"), ln
+        labels = []
+        raw = m.group("labels")
+        if raw is not None:
+            rebuilt = []
+            for lm in _LABEL_PAIR_RE.finditer(raw):
+                assert _LABEL_RE.match(lm.group(1)), ln
+                labels.append((lm.group(1),
+                               _unescape(lm.group(2))))
+                rebuilt.append(lm.group(0))
+            assert ",".join(rebuilt) == raw, \
+                f"junk inside label braces: {ln!r}"
+        value = float(m.group("value"))
+        fam = _base_family(m.group("name"), families)
+        assert fam in families, f"sample without TYPE: {ln!r}"
+        assert families[fam]["type"] is not None, ln
+        key = (m.group("name"), tuple(sorted(labels)))
+        assert key not in [(s[0], s[1]) for s in
+                           families[fam]["samples"]], \
+            f"duplicate series: {key}"
+        families[fam]["samples"].append(
+            (m.group("name"), tuple(sorted(labels)), value))
+    # histogram invariants: cumulative buckets, +Inf == _count
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        series = {}
+        for sname, labels, value in fam["samples"]:
+            rest = tuple(kv for kv in labels if kv[0] != "le")
+            series.setdefault(rest, {"buckets": [], "sum": None,
+                                     "count": None})
+            if sname == name + "_bucket":
+                le = dict(labels)["le"]
+                series[rest]["buckets"].append(
+                    (float("inf") if le == "+Inf" else float(le),
+                     value))
+            elif sname == name + "_sum":
+                series[rest]["sum"] = value
+            elif sname == name + "_count":
+                series[rest]["count"] = value
+        for rest, s in series.items():
+            assert s["buckets"], (name, rest)
+            les = [b[0] for b in s["buckets"]]
+            assert les == sorted(les), (name, rest)
+            assert les[-1] == float("inf"), (name, rest)
+            counts = [b[1] for b in s["buckets"]]
+            assert counts == sorted(counts), (name, rest)
+            assert s["count"] == counts[-1], (name, rest)
+            assert s["sum"] is not None, (name, rest)
+    return families
+
+
+def _reserialize(families):
+    """Canonical re-render of a strict_parse model (0.0.4 flavor,
+    exemplars dropped, label order normalized)."""
+    out = []
+    for name, fam in families.items():
+        out.append(f"# HELP {name} {fam['help']}")
+        out.append(f"# TYPE {name} {fam['type']}")
+        for sname, labels, value in fam["samples"]:
+            lab = ",".join(
+                f'{k}="{v}"' for k, v in labels)
+            val = "+Inf" if value == float("inf") else repr(value)
+            out.append(f"{sname}{{{lab}}} {val}" if lab
+                       else f"{sname} {val}")
+    return "\n".join(out) + "\n"
+
+
+class TestStrictPromRoundTrip:
+    @pytest.mark.parametrize("sched", ["on", "off"])
+    @pytest.mark.parametrize("openmetrics", [False, True])
+    def test_full_metrics_round_trip(self, sched, openmetrics):
+        from trivy_tpu.rpc.server import ScanServer
+        srv = ScanServer(sched=(sched if sched == "on" else None))
+        try:
+            # exercise a request so histograms carry counts
+            srv.slo.record("ok", latency_s=0.01,
+                           trace_id="ab" * 16)
+            text = srv.metrics_text(openmetrics=openmetrics)
+        finally:
+            srv.close()
+        fams = strict_parse(text, openmetrics)
+        assert "trivy_tpu_build_info" in fams
+        assert "trivy_tpu_recorder_dump_bytes" in fams
+        # round-trip: canonical re-render must strict-parse back
+        # to the IDENTICAL model (modulo exemplars, which only
+        # decorate openmetrics bucket lines)
+        again = strict_parse(_reserialize(fams),
+                             openmetrics=False)
+        assert again == fams
+
+    def test_openmetrics_exemplars_present_and_legal(self):
+        from trivy_tpu.rpc.server import ScanServer
+        srv = ScanServer()
+        try:
+            srv.slo.record("ok", latency_s=0.01,
+                           trace_id="cd" * 16)
+            om = srv.metrics_text(openmetrics=True)
+            plain = srv.metrics_text(openmetrics=False)
+        finally:
+            srv.close()
+        assert om.rstrip("\n").endswith("# EOF")
+        assert "# EOF" not in plain
+        ex_lines = [ln for ln in om.splitlines() if " # {" in ln]
+        assert ex_lines, "no exemplars on openmetrics histograms"
+        for ln in ex_lines:
+            assert re.search(
+                r' # \{trace_id="[0-9a-f]+"\} [0-9.eE+-]+', ln), ln
+        assert not any(" # {" in ln for ln in plain.splitlines())
+
+    def test_federated_exposition_strict_parses(self):
+        from trivy_tpu.obs.federate import Federator
+        from trivy_tpu.rpc.server import ScanServer
+
+        peer = ScanServer()
+        front = None
+        try:
+            snap = peer.metrics_snapshot()
+            front = ScanServer(
+                replica_name="front",
+                federator=Federator([("peerA", "http://x")],
+                                    fetch=lambda url: snap))
+            text = front.federate_text()
+        finally:
+            peer.close()
+            if front is not None:
+                front.close()
+        fams = strict_parse(text, openmetrics=False)
+        assert "trivy_tpu_fleet_slo_ok" in fams
+        ups = fams["trivy_tpu_federate_peer_up"]["samples"]
+        assert [(dict(s[1])["replica"], s[2])
+                for s in ups] == [("peerA", 1.0)]
+        # every local family's samples carry the replica label
+        binfo = fams["trivy_tpu_build_info"]["samples"]
+        assert {dict(s[1])["replica"] for s in binfo} == \
+            {"front", "peerA"}

@@ -494,7 +494,27 @@ def build_parser() -> argparse.ArgumentParser:
                      "objective=0.999;lat:kind=latency,"
                      "objective=0.95,threshold_s=2.5' — burn-rate "
                      "verdicts at GET /slo, gauges on /metrics; "
-                     "default: 99% availability + 95% under 30s")
+                     "default: 99%% availability + 95%% under 30s")
+    srv.add_argument("--federate-peers", default="",
+                     help="metrics/SLO federation "
+                     "(docs/observability.md 'Fleet plane'): "
+                     "'name=http://host:port,...' (or bare URLs); "
+                     "this replica then serves the merged fleet "
+                     "exposition at GET /metrics/federate and fleet "
+                     "burn-rate verdicts under GET /slo 'fleet'")
+    srv.add_argument("--federate-timeout", type=float, default=2.0,
+                     help="per-peer snapshot-pull timeout in "
+                     "seconds; a slow peer is marked stale, never "
+                     "awaited past this")
+    srv.add_argument("--federate-stale-after", type=float,
+                     default=60.0,
+                     help="seconds after which a peer's last-good "
+                     "snapshot stops counting as fresh (the peer is "
+                     "exported with trivy_tpu_federate_peer_stale=1)")
+    srv.add_argument("--replica-name", default="",
+                     help="this replica's value for the federated "
+                     "'replica' metrics label (default: the "
+                     "--listen address)")
     _admission_flags(srv)
     srv.add_argument("--images-dir", default="",
                      help="resolve admission-webhook image refs to "
@@ -597,7 +617,7 @@ def main(argv=None) -> int:
     # flag yields an artifact while the server is still up
     profile_window = float(
         os.environ.get("TRIVY_TPU_PROFILE_SECONDS", "60")) \
-        if args.command == "server" else 0.0
+        if args.command in ("server", "watch") else 0.0
     try:
         with scan_deadline(timeout_s), \
                 _profiled(profile_dir, profile_window):
@@ -956,13 +976,31 @@ def run_server(args) -> int:
             secret_scanner=BatchSecretScanner(backend="tpu"))
         sched = scheduler
     injector = _fault_injector(args)
+    federator = None
+    if getattr(args, "federate_peers", ""):
+        from .obs.federate import Federator, parse_peers
+        try:
+            peers = parse_peers(args.federate_peers)
+        except ValueError as e:
+            print(f"error: --federate-peers: {e}", file=sys.stderr)
+            return 2
+        federator = Federator(
+            peers, token=args.auth_token,
+            token_header=args.token_header,
+            timeout_s=getattr(args, "federate_timeout", 2.0),
+            stale_after_s=getattr(args, "federate_stale_after",
+                                  60.0))
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
                         token_header=args.token_header,
                         sched=sched,
                         slos=None if scheduler is not None else slos,
-                        memo=_memo(args, injector=injector))
+                        memo=_memo(args, injector=injector),
+                        federator=federator,
+                        replica_name=(
+                            getattr(args, "replica_name", "")
+                            or args.listen))
     server.fault_injector = injector
     adm_runner = None
     try:
@@ -1041,6 +1079,7 @@ def run_watch(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     holder = SwappableStore(store)
+    _trace_out(args)
     opt = _artifact_option(args)
     injector = _fault_injector(args)
     cache = _cache(args)

@@ -128,6 +128,21 @@ def render_prometheus(stats: dict, phase_hists=None,
     type); False keeps the 0.0.4 output byte-stable."""
     w = _Writer()
 
+    binfo = stats.get("build_info") or {}
+    if binfo:
+        # info-style identity gauge (value always 1; the labels are
+        # the payload) — lets a fleet scrape tell replica versions
+        # apart during a rolling deploy
+        name = f"{_PREFIX}_build_info"
+        w.header(name, "gauge",
+                 "Build/version identity; value is always 1, the "
+                 "labels carry the information.")
+        w.sample(name, [("version", binfo.get("version", "")),
+                        ("jax_version",
+                         binfo.get("jax_version", "")),
+                        ("backend", binfo.get("backend", "")),
+                        ("sched", binfo.get("sched", ""))], 1)
+
     counters = stats.get("counters") or {}
     if counters:
         name = f"{_PREFIX}_sched_events_total"
@@ -514,6 +529,14 @@ def render_prometheus(stats: dict, phase_hists=None,
         w.scalar(f"{_PREFIX}_flight_recorder_dumps_total",
                  "counter", "Crash-dump traces written to disk.",
                  recorder_stats.get("dumps"))
+        w.scalar(f"{_PREFIX}_recorder_dump_bytes", "gauge",
+                 "Bytes of flight-recorder dump files currently "
+                 "on disk.", recorder_stats.get("dump_bytes"))
+        w.scalar(f"{_PREFIX}_recorder_dumps_pruned_total",
+                 "counter",
+                 "Dump files pruned (DUMP_CAP count-FIFO or "
+                 "TRIVY_TPU_DUMP_MAX_AGE_S age cap).",
+                 recorder_stats.get("dumps_pruned"))
 
     _histograms(w, "sched_phase_latency", "phase", phase_hists or {},
                 "Scheduler per-phase latency (queue_wait, analyze, "

@@ -23,6 +23,12 @@ import logging
 import os
 import tempfile
 import threading
+import time
+
+# age-based dump pruning (seconds; unset/0 = off): long-lived
+# servers with occasional failures keep DUMP_CAP files forever
+# otherwise — a fleet of them is DUMP_CAP x N stale evidence
+DUMP_MAX_AGE_ENV = "TRIVY_TPU_DUMP_MAX_AGE_S"
 
 
 class FlightRecorder:
@@ -41,9 +47,16 @@ class FlightRecorder:
         self.logs: collections.deque = collections.deque(
             maxlen=max(1, log_capacity))
         self._dump_dir = dump_dir
+        # (path, insert monotonic, bytes) — insert-time monotonic
+        # stamps keep age pruning on the monotonic clock (the wall
+        # mtime would reintroduce time.time() arithmetic, which the
+        # obs clock lint forbids)
         self._dump_paths: collections.deque = collections.deque()
         self.evicted = 0
         self.dumps = 0
+        self.dump_bytes = 0
+        self.dumps_pruned = 0
+        self._clock = time.monotonic   # injectable (age-prune tests)
         # the owning Tracer's monotonic epoch — dump() subtracts it
         # so every dump in the dir shares one timebase (us since
         # tracer start), whoever triggers the dump (a failed scan,
@@ -148,13 +161,39 @@ class FlightRecorder:
             raise OSError(
                 f"refusing to dump into {d!r}: owned by another uid")
         self.write_doc(path, doc)
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = 0
+        try:
+            max_age = float(os.environ.get(DUMP_MAX_AGE_ENV,
+                                           "0") or 0)
+        except ValueError:
+            max_age = 0.0
+        now = self._clock()
         with self._lock:
             self.dumps += 1
-            self._dump_paths.append(path)
+            # re-dumping a trace replaces its entry (same file name):
+            # the books must not double-count the bytes or prune the
+            # live file out from under the newer entry
+            for i, (p, _, b) in enumerate(self._dump_paths):
+                if p == path:
+                    del self._dump_paths[i]
+                    self.dump_bytes -= b
+                    break
+            self._dump_paths.append((path, now, nbytes))
+            self.dump_bytes += nbytes
             prune = []
+            if max_age > 0:
+                while self._dump_paths and \
+                        now - self._dump_paths[0][1] > max_age:
+                    prune.append(self._dump_paths.popleft())
             while len(self._dump_paths) > self.DUMP_CAP:
                 prune.append(self._dump_paths.popleft())
-        for old in prune:
+            for _, _, b in prune:
+                self.dump_bytes -= b
+            self.dumps_pruned += len(prune)
+        for old, _, _ in prune:
             try:
                 os.remove(old)
             except OSError:
@@ -167,6 +206,9 @@ class FlightRecorder:
                     "capacity": self.capacity,
                     "evicted": self.evicted,
                     "dumps": self.dumps,
+                    "dump_files": len(self._dump_paths),
+                    "dump_bytes": self.dump_bytes,
+                    "dumps_pruned": self.dumps_pruned,
                     "logs": len(self.logs)}
 
 

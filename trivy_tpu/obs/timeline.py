@@ -214,9 +214,15 @@ class Timeline:
         return out
 
     def _attribute_gap(self, lo: float, hi: float) -> list:
-        """Partition one idle gap into (cause, seconds) pieces:
-        sweep the elementary sub-intervals between all cause
-        boundaries, assigning each to its highest-priority cover."""
+        return [(cause, b - a)
+                for cause, a, b in self.gap_pieces(lo, hi)]
+
+    def gap_pieces(self, lo: float, hi: float) -> list:
+        """Partition one idle gap into positioned (cause, a, b)
+        pieces: sweep the elementary sub-intervals between all cause
+        boundaries, assigning each to its highest-priority cover.
+        The positions let the fleet merge re-split pieces against
+        peer busy intervals without breaking the partition."""
         pts = {lo, hi}
         for _, ivs in self._cause_ivs:
             for s, e in _clip(ivs, lo, hi):
@@ -238,7 +244,7 @@ class Timeline:
                 cause = "unknown" if any(
                     s <= mid < e for s, e in self._open) \
                     else "queue_empty"
-            out.append((cause, b - a))
+            out.append((cause, a, b))
         return out
 
     # --- intervals ---
@@ -327,3 +333,204 @@ def from_recorder(recorder, window=None) -> Timeline:
 
 def from_tracer(tracer, window=None) -> Timeline:
     return from_recorder(tracer.recorder, window=window)
+
+
+# --- fleet merge (docs/observability.md "Fleet plane") -------------
+#
+# N processes export their spans (plus their monotonic epoch), the
+# coordinator estimates pairwise clock offsets (obs/propagate.py)
+# and merges everything onto ONE aligned monotonic axis. Each host
+# keeps its own exact partition; the only new cause is
+# ``peer_straggler`` — idle a host spent with no local explanation
+# while some OTHER host's device was still busy, i.e. waiting on the
+# slowest shard. It is carved out of queue_empty/unknown by
+# re-splitting those pieces against the union of peer busy
+# intervals, so per-host sum(causes) == idle still holds exactly.
+
+FLEET_CAUSES = CAUSES + ("peer_straggler",)
+
+# pieces eligible for peer_straggler reattribution: causes with a
+# LOCAL explanation (uploads, host phases, ring stalls) keep their
+# attribution even while a peer lags — only "nothing local was
+# happening" time can be the fault of the slowest shard
+_PEER_ELIGIBLE = frozenset({"queue_empty", "unknown"})
+
+
+class SpanLite:
+    """Deserialized exported span — duck-types the Span fields
+    :class:`Timeline` reads, with the host's estimated clock offset
+    already applied to both timestamps."""
+
+    noop = False
+    __slots__ = ("name", "trace_id", "span_id", "parent_id",
+                 "start_mono", "end_mono", "status", "attrs",
+                 "is_root")
+
+    def __init__(self, doc: dict, offset_s: float = 0.0):
+        self.name = str(doc.get("name") or "")
+        self.trace_id = str(doc.get("trace_id") or "")
+        self.span_id = str(doc.get("span_id") or "")
+        self.parent_id = doc.get("parent_id") or None
+        self.start_mono = float(doc.get("start_mono") or 0.0) \
+            + offset_s
+        end = doc.get("end_mono")
+        self.end_mono = None if end is None \
+            else float(end) + offset_s
+        self.status = str(doc.get("status") or "ok")
+        attrs = doc.get("attrs")
+        self.attrs = dict(attrs) if isinstance(attrs, dict) else {}
+        self.is_root = bool(doc.get("is_root",
+                                    self.parent_id is None))
+
+
+def export_spans(spans: list, process: str = "",
+                 epoch_mono: float = 0.0) -> dict:
+    """JSON-able export of finished spans + the process's monotonic
+    epoch — the unit the simhost output file and the federate
+    snapshot carry. Attrs are filtered to JSON scalars."""
+    out = []
+    for s in spans:
+        if getattr(s, "end_mono", None) is None \
+                or getattr(s, "noop", False):
+            continue
+        out.append({
+            "name": s.name,
+            "trace_id": s.trace_id,
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "start_mono": s.start_mono,
+            "end_mono": s.end_mono,
+            "status": getattr(s, "status", "ok"),
+            "is_root": bool(getattr(s, "is_root",
+                                    s.parent_id is None)),
+            "attrs": {k: v for k, v in
+                      getattr(s, "attrs", {}).items()
+                      if isinstance(v, (str, int, float, bool))},
+        })
+    return {"process": str(process),
+            "epoch_mono": float(epoch_mono),
+            "spans": out}
+
+
+def export_tracer(tracer, process: str = "") -> dict:
+    """Export every completed trace in a tracer's recorder ring."""
+    spans = [s for _, trace in tracer.recorder.traces()
+             for s in trace]
+    return export_spans(spans, process=process,
+                        epoch_mono=tracer.epoch_mono)
+
+
+def load_export(doc: dict, offset_s: float = 0.0) -> list:
+    """Hydrate one export back into Timeline-compatible spans, with
+    ``offset_s`` (local ≈ remote + offset, from
+    :func:`obs.propagate.estimate_offset`) applied."""
+    return [SpanLite(d, offset_s=offset_s)
+            for d in (doc.get("spans") or [])]
+
+
+class MergedTimeline:
+    """N per-process exports on one aligned monotonic axis.
+
+    ``exports`` are :func:`export_spans` documents; ``offsets`` are
+    the per-export clock offsets mapping each host's monotonic
+    timestamps onto the coordinator's axis (local ≈ remote +
+    offset). The fleet window defaults to the union extent of all
+    hosts' spans so trailing idle on fast hosts — the straggler
+    signal — stays in frame."""
+
+    def __init__(self, exports: list, offsets=None, window=None):
+        offsets = list(offsets) if offsets is not None \
+            else [0.0] * len(exports)
+        if len(offsets) != len(exports):
+            raise ValueError("one offset per export required")
+        self.hosts = []
+        for i, (doc, off) in enumerate(zip(exports, offsets)):
+            name = str(doc.get("process") or f"host{i}")
+            self.hosts.append((name, load_export(doc,
+                                                 offset_s=off)))
+        extents = [Timeline(spans) for _, spans in self.hosts]
+        with_spans = [t for t in extents if t.spans]
+        if window is not None:
+            self.t0, self.t1 = float(window[0]), float(window[1])
+        elif with_spans:
+            self.t0 = min(t.t0 for t in with_spans)
+            self.t1 = max(t.t1 for t in with_spans)
+        else:
+            self.t0 = self.t1 = 0.0
+        self.timelines = [
+            (name, Timeline(spans, window=(self.t0, self.t1)))
+            for name, spans in self.hosts]
+
+    @property
+    def window_s(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+    def per_host(self) -> list:
+        """[{process, busy_s, idle_s, attribution, coverage,
+        last_busy_end_s}] — each host's exact partition over the
+        COMMON fleet window, with peer_straggler carved out of
+        unexplained idle covered by some other host's busy time."""
+        busy_by_host = [tl.busy_intervals()
+                        for _, tl in self.timelines]
+        out = []
+        for i, (name, tl) in enumerate(self.timelines):
+            peers_busy = _merge([iv
+                                 for j, ivs in
+                                 enumerate(busy_by_host)
+                                 if j != i for iv in ivs])
+            attr = {c: 0.0 for c in FLEET_CAUSES}
+            for lo, hi in tl.idle_intervals():
+                for cause, a, b in tl.gap_pieces(lo, hi):
+                    if cause in _PEER_ELIGIBLE:
+                        covered = _overlap_s(peers_busy, a, b)
+                        attr["peer_straggler"] += covered
+                        attr[cause] += (b - a) - covered
+                    else:
+                        attr[cause] += b - a
+            busy = tl.busy_s
+            idle = tl.idle_s
+            last = max((e for _, e in busy_by_host[i]),
+                       default=self.t0)
+            out.append({
+                "process": name,
+                "busy_s": round(busy, 6),
+                "idle_s": round(idle, 6),
+                "attribution": {c: round(v, 6)
+                                for c, v in attr.items()},
+                "coverage": round(1.0 - attr["unknown"] / idle, 4)
+                if idle > 0 else 1.0,
+                "last_busy_end_s": round(last - self.t0, 6),
+            })
+        return out
+
+    def report(self) -> dict:
+        """Fleet summary + the per-host burn-down list (hosts sorted
+        by when their device went quiet, latest first — the ROADMAP
+        item-1 view of who the straggler was)."""
+        hosts = self.per_host()
+        idle = sum(h["idle_s"] for h in hosts)
+        unknown = sum(h["attribution"]["unknown"] for h in hosts)
+        fleet_attr = {c: round(sum(h["attribution"][c]
+                                   for h in hosts), 6)
+                      for c in FLEET_CAUSES}
+        return {
+            "window_s": round(self.window_s, 6),
+            "hosts": hosts,
+            "fleet": {
+                "busy_s": round(sum(h["busy_s"] for h in hosts),
+                                6),
+                "idle_s": round(idle, 6),
+                "attribution": fleet_attr,
+                "coverage": round(1.0 - unknown / idle, 4)
+                if idle > 0 else 1.0,
+            },
+            "burn_down": [
+                {"process": h["process"],
+                 "finished_at_s": h["last_busy_end_s"],
+                 "busy_s": h["busy_s"],
+                 "peer_straggler_s":
+                     h["attribution"]["peer_straggler"]}
+                for h in sorted(hosts,
+                                key=lambda h:
+                                -h["last_busy_end_s"])],
+        }

@@ -156,7 +156,7 @@ class Span:
     noop = False
     __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
                  "start_wall", "start_mono", "end_mono", "attrs",
-                 "events", "status", "tid")
+                 "events", "status", "tid", "is_root")
 
     def __init__(self, tracer, name: str, trace_id: str,
                  parent_id=None, attrs=None):
@@ -164,6 +164,10 @@ class Span:
         self.trace_id = trace_id
         self.span_id = _new_span_id()
         self.parent_id = parent_id
+        # a propagated (remote) parent makes a LOCAL root whose
+        # parent_id points into another process's trace: is_root, not
+        # parent_id, decides completion bookkeeping from here on
+        self.is_root = parent_id is None
         self.name = name
         self.start_wall = time.time()
         self.start_mono = time.monotonic()
@@ -206,6 +210,7 @@ class _NoopSpan:
     trace_id = ""
     span_id = ""
     parent_id = None
+    is_root = False
     name = ""
     attrs: dict = {}
     events: list = []
@@ -253,6 +258,12 @@ class Tracer:
         self.epoch_mono = time.monotonic()
         self._lock = threading.Lock()
         self._spans: dict = {}    # open trace_id -> [finished Span]
+        # propagated traces can have several concurrently-open LOCAL
+        # roots on one trace_id (N scans sharing a fleet trace): the
+        # bucket completes when the LAST root ends, and a bad status
+        # on any earlier root still forces the dump
+        self._open_roots: dict = {}   # trace_id -> open root count
+        self._dirty: set = set()      # trace_ids owed a dump
         if recorder is None:
             from .recorder import FlightRecorder
             recorder = FlightRecorder()
@@ -268,7 +279,7 @@ class Tracer:
     # --- span creation ---
 
     def start_span(self, name: str, trace_id: str = "",
-                   parent=None, attrs=None):
+                   parent=None, attrs=None, remote_parent: str = ""):
         if not self.enabled:
             return NOOP_SPAN
         if parent is not None:
@@ -282,19 +293,33 @@ class Tracer:
             return span
         span = Span(self, name,
                     _clean_trace_id(trace_id) or new_trace_id())
+        rp = _clean_trace_id(remote_parent)
+        if rp:
+            # a propagated parent from another process: this span is
+            # still a LOCAL root (it owns its bucket's completion)
+            # but its parent_id links it into the fleet-wide tree
+            span.parent_id = rp
         if attrs:
             span.attrs.update(attrs)
         with self._lock:
             while len(self._spans) >= MAX_OPEN_TRACES:
                 # drop the oldest open trace — a root that never ends
                 # must not pin its children forever
-                self._spans.pop(next(iter(self._spans)))
+                dropped = next(iter(self._spans))
+                self._spans.pop(dropped)
+                self._open_roots.pop(dropped, None)
+                self._dirty.discard(dropped)
             self._spans.setdefault(span.trace_id, [])
+            self._open_roots[span.trace_id] = \
+                self._open_roots.get(span.trace_id, 0) + 1
         return span
 
-    def start_request(self, name: str, trace_id: str = ""):
-        """Root span for one scan request."""
-        root = self.start_span("scan", trace_id=trace_id)
+    def start_request(self, name: str, trace_id: str = "",
+                      parent_span_id: str = ""):
+        """Root span for one scan request; a propagated
+        ``parent_span_id`` links it under a remote caller's span."""
+        root = self.start_span("scan", trace_id=trace_id,
+                               remote_parent=parent_span_id)
         root.set("request", name)
         return root
 
@@ -307,12 +332,12 @@ class Tracer:
     # --- completion plumbing ---
 
     def _finish(self, span: Span) -> None:
-        if self._phase is not None and span.parent_id is not None:
+        if self._phase is not None and not span.is_root:
             self._observe_phase(span.name, span.duration_s,
                                 span.trace_id)
         with self._lock:
             self.n_spans += 1
-            if span.parent_id is not None:
+            if not span.is_root:
                 bucket = self._spans.get(span.trace_id)
                 if bucket is None:
                     # finished after its root (e.g. a sweep resolved
@@ -322,10 +347,26 @@ class Tracer:
                 elif len(bucket) < MAX_SPANS_PER_TRACE:
                     bucket.append(span)
                 return
+            remaining = self._open_roots.get(span.trace_id, 1) - 1
+            if remaining > 0:
+                # sibling roots on the same propagated trace are
+                # still open: file this root like a child and keep
+                # the bucket until the last one ends
+                self._open_roots[span.trace_id] = remaining
+                bucket = self._spans.get(span.trace_id)
+                if bucket is not None and \
+                        len(bucket) < MAX_SPANS_PER_TRACE:
+                    bucket.append(span)
+                if span.status in ("degraded", "failed", "error"):
+                    self._dirty.add(span.trace_id)
+                return
+            self._open_roots.pop(span.trace_id, None)
             spans = self._spans.pop(span.trace_id, [])
             spans.append(span)
             self.n_traces += 1
-        self._complete(span, spans)
+            dirty = span.trace_id in self._dirty
+            self._dirty.discard(span.trace_id)
+        self._complete(span, spans, dirty=dirty)
 
     def _observe_phase(self, name: str, dur_s: float,
                        trace_id: str = "") -> None:
@@ -342,14 +383,15 @@ class Tracer:
                     h = self._phase[name] = LatencyHistogram()
             h.observe(dur_s, exemplar=trace_id)
 
-    def _complete(self, root: Span, spans: list) -> None:
+    def _complete(self, root: Span, spans: list,
+                  dirty: bool = False) -> None:
         self.recorder.add(root.trace_id, spans)
         if self.export_dir:
             try:
                 self._export(root.trace_id, spans)
             except OSError:
                 pass
-        if root.status in ("degraded", "failed", "error"):
+        if dirty or root.status in ("degraded", "failed", "error"):
             # degraded/failed scans dump the full trace to disk so
             # the evidence outlives the in-memory ring ("rejected"
             # backpressure answers deliberately do NOT — a 503 storm
@@ -432,9 +474,12 @@ def to_chrome(spans: list, epoch_mono: float = 0.0,
 def summarize(spans: list) -> str:
     """One-line phase breakdown: 'scan 42.1ms: queue_wait 0.2ms,
     analyze 30.0ms, device 8.1ms, report 2.3ms'."""
-    root = next((s for s in spans if s.parent_id is None), None)
+    root = next((s for s in spans
+                 if getattr(s, "is_root", s.parent_id is None)),
+                None)
     parts = [f"{s.name} {s.duration_s * 1e3:.1f}ms"
-             for s in spans if s.parent_id is not None]
+             for s in spans
+             if not getattr(s, "is_root", s.parent_id is None)]
     head = (f"{root.name} {root.duration_s * 1e3:.1f}ms"
             if root is not None else "")
     if parts:

@@ -22,6 +22,15 @@ Spec JSON: ``{"paths": [tar, ...], "devices": N (per host),
 tables are compiled per process — each host stages its own copy
 through the ResidentTables generation machinery, which is exactly
 the per-host replication contract of the real pod.
+
+Fleet observability (docs/observability.md "Fleet plane"): an
+optional spec ``"traceparent"`` roots this process's span tree under
+the parent's span — the parent's flight recorder then names every
+host in ONE cross-process trace. ``"clock_port_file"`` starts a
+monotonic ClockServer and writes its port, so the parent can
+estimate this process's clock offset pairwise; the output gains
+``"trace"`` (ids for child-link assertions) and ``"timeline"`` (the
+serialized span export + epoch that MergedTimeline aligns).
 """
 
 from __future__ import annotations
@@ -73,13 +82,51 @@ def run_simhost(spec: dict, topo=None) -> dict:
     runner = BatchScanRunner(
         store=cdb, backend="tpu", mesh=mesh,
         dispatch_depth=int(spec.get("dispatch_depth") or 2))
-    results = runner.scan_paths([paths[i] for i in mine])
+
+    from ..obs.propagate import (EMPTY_CONTEXT, ClockServer,
+                                 parse_traceparent)
+    from ..obs.timeline import export_tracer
+    from ..obs.trace import get_tracer
+
+    clock = None
+    port_file = str(spec.get("clock_port_file") or "")
+    if port_file:
+        clock = ClockServer()
+        clock.write_port_file(port_file)
+
+    tracer = get_tracer()
+    process = f"host{topo.process_id}"
+    ctx = parse_traceparent(
+        str(spec.get("traceparent") or "")) or EMPTY_CONTEXT
+    # the simhost root: a LOCAL root span (it completes this
+    # process's bucket) carrying the parent process's span as its
+    # remote parent, so the merged trace links across the seam
+    root = tracer.start_span(
+        "simhost", trace_id=ctx.trace_id,
+        remote_parent=ctx.parent_span_id,
+        attrs={"process": process})
+    try:
+        with root.activate():
+            results = runner.scan_paths([paths[i] for i in mine])
+        root.end()
+    except BaseException:
+        root.end(status="failed")
+        raise
+    finally:
+        if clock is not None:
+            clock.close()
     return {
         "process_id": topo.process_id,
         "num_processes": topo.num_processes,
         "assign": assign,
         "indices": mine,
         "reports": _normalized(results),
+        "trace": {
+            "trace_id": root.trace_id,
+            "root_span_id": root.span_id,
+            "remote_parent": ctx.parent_span_id,
+        },
+        "timeline": export_tracer(tracer, process=process),
     }
 
 

@@ -208,9 +208,19 @@ class RemoteScanner(_Client):
         request's span tree under it, so the caller can pull the
         trace from ``GET /trace/<id>`` — the id is logged at debug
         and kept on ``self.last_trace_id``. Retries reuse the same
-        id: they are attempts at ONE logical request."""
+        id: they are attempts at ONE logical request.
+
+        Fleet propagation (obs/propagate.py): when the caller has an
+        active local span, its context rides a ``traceparent`` field
+        and the server's root becomes a true CHILD of that span —
+        one tree spanning both processes. Without one, a fresh id is
+        minted exactly as before."""
         import uuid
-        self.last_trace_id = uuid.uuid4().hex
+
+        from ..obs.propagate import current_context
+        ctx = current_context()
+        self.last_trace_id = ctx.trace_id if ctx is not None \
+            else uuid.uuid4().hex
         log.debug("scan %r trace_id=%s", target.name,
                   self.last_trace_id)
         deadline_s = float(getattr(options, "deadline_s", 0.0)
@@ -230,6 +240,8 @@ class RemoteScanner(_Client):
                 "backend": getattr(options, "backend", "tpu"),
             },
         }
+        if ctx is not None:
+            body["traceparent"] = ctx.to_header()
         if deadline_s:
             body["deadline_s"] = deadline_s
         if self.tenant:

@@ -25,6 +25,8 @@ from ..scan.local import LocalScanner, ScanTarget
 from ..types import ScanOptions
 from ..types.convert import (artifact_info_from_dict,
                              blob_info_from_dict)
+from ..obs.propagate import TRACEPARENT_HEADER
+from ..obs.propagate import extract as extract_context
 from ..utils import get_logger
 
 log = get_logger("rpc.server")
@@ -196,7 +198,8 @@ class ScanServer:
                  max_body_bytes: int = MAX_BODY_BYTES,
                  max_scan_blobs: int = MAX_SCAN_BLOBS,
                  tracer=None, slos=None, memo=None,
-                 admission=None, watch_source=None):
+                 admission=None, watch_source=None,
+                 federator=None, replica_name: str = "self"):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -280,6 +283,24 @@ class ScanServer:
         # watch.WebhookSource fed by POST /registry/notifications
         self.admission = admission
         self.watch_source = watch_source
+        # fleet federation (docs/observability.md "Fleet plane"):
+        # an obs.federate.Federator makes this replica a federating
+        # front — GET /metrics/federate pulls every peer's snapshot
+        # and serves the merged exposition + fleet SLO verdicts
+        self.federator = federator
+        self.replica_name = replica_name
+
+    def build_info(self) -> dict:
+        """The trivy_tpu_build_info identity labels (also mirrored
+        into the /healthz JSON so probes see versions token-free)."""
+        from ..sched.metrics import build_info
+        backend = ""
+        if self.scheduler is not None:
+            cfg = getattr(self.scheduler, "config", None)
+            backend = str(getattr(cfg, "backend", "") or "")
+        return build_info(
+            backend=backend,
+            sched="on" if self.scheduler is not None else "off")
 
     def close(self) -> None:
         # only tear down a scheduler this server constructed — an
@@ -380,8 +401,10 @@ class ScanServer:
         # readers hold the store across the whole scan; swap waits
         # for them to drain (SwappableStore), like the server's
         # dbUpdateWg/requestWg pair
+        ctx = extract_context(body)
         root = self.tracer.start_request(
-            target.name, trace_id=str(body.get("trace_id") or ""))
+            target.name, trace_id=ctx.trace_id,
+            parent_span_id=ctx.parent_span_id)
         db = self.store.acquire()
         t0 = time.monotonic()
         tenant = _clean_tenant(body.get("tenant"))
@@ -447,10 +470,14 @@ class ScanServer:
             # Priority jumps the line only WITHIN the tenant.
             tenant=_clean_tenant(body.get("tenant")),
             priority=max(-100, min(100, priority)),
-            # the client's trace_id rides the body; the scheduler's
-            # tracer validates it (hex only — it becomes a dump file
-            # name) and roots this request's span tree under it
-            trace_id=str(body.get("trace_id") or "")[:64])
+            # the client's propagated context rides the body
+            # (traceparent, or the legacy bare trace_id); the
+            # scheduler's tracer validates both ids (hex only — the
+            # trace id becomes a dump file name) and roots this
+            # request's span tree under the caller's span
+            trace_id=extract_context(body).trace_id[:64],
+            parent_span_id=extract_context(body)
+            .parent_span_id[:64])
         try:
             self.scheduler.submit(req)
         except BaseException:
@@ -517,6 +544,9 @@ class ScanServer:
             out["cache_breaker"] = breaker()
         out["trace"] = dict(self.tracer.stats(),
                             recorder=self.tracer.recorder.stats())
+        out["build_info"] = self.build_info()
+        if self.federator is not None:
+            out["federation"] = self.federator.stats()
         return out
 
     def metrics_text(self, openmetrics: bool = False) -> str:
@@ -547,8 +577,43 @@ class ScanServer:
 
     def slo_verdicts(self) -> dict:
         """The ``GET /slo`` payload: per-SLO burn rates, trip state
-        and exemplar trace ids (docs/observability.md)."""
-        return self.slo.snapshot()
+        and exemplar trace ids (docs/observability.md). A federating
+        front also answers the fleet question — ``fleet.slo_ok`` is
+        burn math over every replica's merged event buckets, with
+        ``complete: false`` flagging a partial view (peer down or
+        stale) rather than pretending the fleet is healthy."""
+        out = self.slo.snapshot()
+        if self.federator is not None:
+            rows = self.federator.collect()
+            out["fleet"] = self.federator.fleet_slo(
+                self.slo.export_state(), rows)
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        """The ``GET /metrics/snapshot`` payload a federating front
+        pulls: replica identity, the full prom exposition, and the
+        SLO engine's age-keyed bucket export (monotonic-only, so the
+        front can rebase it onto its own clock)."""
+        return {"name": self.replica_name,
+                "build_info": self.build_info(),
+                "prom": self.metrics_text(),
+                "slo_export": self.slo.export_state(),
+                "mono": time.monotonic()}
+
+    def federate_text(self) -> str:
+        """The ``GET /metrics/federate`` exposition: this replica's
+        families merged with every reachable peer's, each sample
+        carrying a bounded-cardinality ``replica`` label, plus the
+        fleet SLO verdict gauges. Raises LookupError when the server
+        was started without ``--federate-peers``."""
+        if self.federator is None:
+            raise LookupError("federation not configured")
+        rows = self.federator.collect()
+        fleet = self.federator.fleet_slo(
+            self.slo.export_state(), rows)
+        return self.federator.render(
+            self.replica_name, self.metrics_text(), rows,
+            fleet=fleet)
 
     def profile_text(self, seconds=None) -> str:
         """Collapsed-stack host profile over the last ``seconds``
@@ -657,7 +722,38 @@ def _make_handler(server: ScanServer):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {"status": "ok"})
+                self._reply(200, {"status": "ok",
+                                  "build": server.build_info()})
+            elif self.path == "/metrics/snapshot":
+                # the federation pull: replica identity + prom text
+                # + age-keyed SLO bucket export, token-protected like
+                # every operational route
+                if not self._authorized():
+                    return
+                self._reply(200, server.metrics_snapshot())
+            elif self.path == "/metrics/federate":
+                # fleet exposition: this replica merged with every
+                # reachable peer, one replica label per sample
+                if not self._authorized():
+                    return
+                try:
+                    text = server.federate_text()
+                except LookupError:
+                    self._reply(404, {
+                        "code": "bad_route",
+                        "msg": "federation not configured "
+                               "(--federate-peers)"})
+                    return
+                self._reply_text(
+                    200, text,
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/clock":
+                # monotonic clock probe for pairwise offset
+                # estimation (obs/propagate.py): token-protected —
+                # a clock readout fingerprints process uptime
+                if not self._authorized():
+                    return
+                self._reply(200, {"mono": time.monotonic()})
             elif self.path == "/metrics":
                 # /healthz stays open (probes), but the operational
                 # detail in /metrics honors the server token
@@ -775,6 +871,13 @@ def _make_handler(server: ScanServer):
             if tenant_hdr and isinstance(body, dict) \
                     and not body.get("tenant"):
                 body["tenant"] = tenant_hdr
+            # trace context: an explicit body field wins, else the
+            # Traceparent header — folded here so every route (scan,
+            # notifications, admission) sees one canonical place
+            tp_hdr = self.headers.get(TRACEPARENT_HEADER)
+            if tp_hdr and isinstance(body, dict) \
+                    and not body.get("traceparent"):
+                body["traceparent"] = tp_hdr
             # continuous-scanning routes (docs/serving.md): the
             # registry notification webhook and the K8s admission
             # webhook answer their own protocols, not twirp

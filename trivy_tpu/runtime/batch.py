@@ -284,7 +284,8 @@ class BatchScanRunner:
 
     def submit_path(self, path: str,
                     options: Optional[ScanOptions] = None,
-                    tenant: str = "", priority: int = 0):
+                    tenant: str = "", priority: int = 0,
+                    trace_id: str = "", parent_span_id: str = ""):
         """Serving-mode entry: enqueue ONE image scan through the
         scheduler and return its ScanRequest future (``.result()``
         blocks; raises QueueFullError on backpressure, or
@@ -296,10 +297,13 @@ class BatchScanRunner:
         sched = self.scheduler
         return sched.submit(
             self._image_request(sched, path, None, options,
-                                tenant=tenant, priority=priority))
+                                tenant=tenant, priority=priority,
+                                trace_id=trace_id,
+                                parent_span_id=parent_span_id))
 
     def _image_request(self, sched, name: str, image, options,
-                       tenant: str = "", priority: int = 0):
+                       tenant: str = "", priority: int = 0,
+                       trace_id: str = "", parent_span_id: str = ""):
         from ..sched import AnalyzedWork, ScanRequest
 
         scan_secrets = "secret" in options.security_checks
@@ -393,11 +397,23 @@ class BatchScanRunner:
                                 jobs=prepared.jobs, patch=patch,
                                 finish=finish, deps=deps)
 
+        if not trace_id and not parent_span_id:
+            # ambient fleet context (obs/propagate.py): a scan
+            # submitted under an active span — the simhost root, a
+            # watch event's propagated context — joins that trace
+            # instead of starting an unlinked one
+            from ..obs.propagate import current_context
+            ctx = current_context()
+            if ctx is not None:
+                trace_id = ctx.trace_id
+                parent_span_id = ctx.parent_span_id
         return ScanRequest(name=name or getattr(image, "name", ""),
                            analyze=analyze,
                            deadline_s=getattr(options, "deadline_s",
                                               0.0) or 0.0,
-                           tenant=tenant, priority=priority)
+                           tenant=tenant, priority=priority,
+                           trace_id=trace_id[:64],
+                           parent_span_id=parent_span_id[:64])
 
     def _scan_images(self, images: list,
                      options: Optional[ScanOptions] = None) -> list:
@@ -420,13 +436,23 @@ class BatchScanRunner:
         # queue, so each image's span tree is analyze → device (the
         # fleet-shared dispatch window) → report
         tracer = self.tracer
+        # ambient fleet context (obs/propagate.py): scans launched
+        # under an active span (the simhost root, a propagated watch
+        # submission) join that trace — per-image roots become its
+        # remote-style children; with no ambient span the behavior
+        # is byte-identical to the single-process path
+        from ..obs.propagate import current_context
+        amb = current_context()
         t0 = _time.perf_counter()
         slots, failures = [], {}     # [(input idx, artifact)]
         roots: dict = {}             # input idx -> root span
         opt = self._image_opt(scan_secrets)
         for idx, img in enumerate(images):
             name = getattr(img, "name", "")
-            root = tracer.start_request(name)
+            root = tracer.start_request(
+                name,
+                trace_id=amb.trace_id if amb else "",
+                parent_span_id=amb.parent_span_id if amb else "")
             roots[idx] = root
             a = _CollectingImageArtifact(img, self.cache, opt)
             sp = tracer.child(root, "analyze")

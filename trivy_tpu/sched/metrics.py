@@ -23,6 +23,24 @@ import time
 from bisect import bisect_left
 
 
+def build_info(backend: str = "", sched: str = "") -> dict:
+    """The ``trivy_tpu_build_info`` identity labels (value-1 info
+    gauge on /metrics, mirrored into the /healthz JSON): enough for
+    a fleet scrape to tell replica versions apart mid-rolling-
+    deploy. jax is resolved lazily and tolerated missing — metrics
+    must render on a box with no accelerator stack at all."""
+    from .. import __version__
+    try:
+        import jax
+        jax_version = getattr(jax, "__version__", "")
+    except Exception:   # noqa: BLE001 — any import-time failure
+        jax_version = ""
+    return {"version": __version__,
+            "jax_version": jax_version,
+            "backend": str(backend or ""),
+            "sched": str(sched or "")}
+
+
 class LatencyHistogram:
     """Fixed-bound latency histogram (seconds) with quantile
     estimates by linear interpolation inside the winning bucket.

@@ -81,7 +81,7 @@ class ScanRequest:
                  deadline_s: float = 0.0, group: str = "",
                  on_done: Optional[Callable] = None,
                  trace_id: str = "", tenant: str = "",
-                 priority: int = 0):
+                 priority: int = 0, parent_span_id: str = ""):
         self.name = name
         self.analyze = analyze
         self.group = group
@@ -94,6 +94,10 @@ class ScanRequest:
         # propagate theirs) is honored by the scheduler's tracer,
         # which fills these span slots at each stage boundary
         self.trace_id = trace_id
+        # fleet propagation (obs/propagate.py): a remote caller's
+        # span id, making the scheduler's root a child in a cross-
+        # process trace instead of an unlinked sibling
+        self.parent_span_id = parent_span_id
         self.span_root = None
         self.span_queue = None
         self.span_coalesce = None

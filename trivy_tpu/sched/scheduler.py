@@ -242,8 +242,9 @@ class ScanScheduler:
             request.deadline = (request.submitted_at +
                                 self.config.default_deadline_s)
         request.group = request.group or self.backend
-        root = self.tracer.start_request(request.name,
-                                         trace_id=request.trace_id)
+        root = self.tracer.start_request(
+            request.name, trace_id=request.trace_id,
+            parent_span_id=getattr(request, "parent_span_id", ""))
         request.trace_id = root.trace_id
         request.span_root = root
         request.span_queue = self.tracer.child(root, "queue_wait")

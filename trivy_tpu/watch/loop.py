@@ -176,6 +176,13 @@ class WatchLoop:
             self._count("unresolvable")
             self._shed(group)
             return
+        # propagated trace context: a traceparent on the source
+        # event roots this scan under the submitter's span (fleet
+        # plane); garbage parses to the empty context, i.e. a fresh
+        # local trace — exactly the no-propagation behavior
+        from ..obs.propagate import EMPTY_CONTEXT, parse_traceparent
+        ctx = parse_traceparent(getattr(ev, "traceparent", "")) \
+            or EMPTY_CONTEXT
         attempts = max(1, cfg.submit_retries)
         for attempt in range(attempts):
             retry = attempt + 1 < attempts
@@ -183,7 +190,9 @@ class WatchLoop:
                 group.req = self.runner.submit_path(
                     ev.path, self.options,
                     tenant=ev.tenant or cfg.tenant,
-                    priority=ev.priority or cfg.priority)
+                    priority=ev.priority or cfg.priority,
+                    trace_id=ctx.trace_id,
+                    parent_span_id=ctx.parent_span_id)
                 break
             except RateLimitedError as e:
                 # no sleep after the FINAL attempt: the pump is

@@ -57,6 +57,10 @@ class PushEvent:
     priority: int = 0
     seq: int = -1              # per-source cursor position
     event_id: str = ""
+    # propagated trace context (fleet plane): a traceparent on the
+    # notification envelope rides every event it yields, so the scan
+    # the watcher submits joins the submitter's trace
+    traceparent: str = ""
     ts: float = field(default_factory=time.monotonic)
 
 
@@ -73,6 +77,7 @@ def parse_notification(body, resolver=None, tenant: str = "",
             not isinstance(body.get("events"), list):
         WATCH_METRICS.inc("malformed")
         return events, 1
+    traceparent = str(body.get("traceparent") or "")
     for ev in body["events"]:
         if not isinstance(ev, dict):
             malformed += 1
@@ -95,7 +100,8 @@ def parse_notification(body, resolver=None, tenant: str = "",
         events.append(PushEvent(digest=digest, ref=ref,
                                 path=path or "", tenant=tenant,
                                 priority=priority,
-                                event_id=str(ev.get("id") or "")))
+                                event_id=str(ev.get("id") or ""),
+                                traceparent=traceparent))
     if malformed:
         WATCH_METRICS.inc("malformed", malformed)
     return events, malformed
