@@ -2487,6 +2487,246 @@ def bench_fleet_obs() -> dict:
     return out
 
 
+def bench_router() -> dict:
+    """Fault-tolerant scan-router bench (docs/serving.md "Scan
+    router & autoscaling"). Four gated arms:
+
+    * **parity** — findings through the router front byte-identical
+      to a direct replica scan (real ScanServers);
+    * **scaling** — closed-loop sim-fleet throughput at 4 replicas
+      >= 0.8 x 4 the single-replica rate (each sim replica has
+      finite parallelism, so the ratio measures the ring's load
+      spreading, not sleep parallelism), with attributed router
+      overhead — route wall minus upstream wait — < 2%;
+    * **kill** — one subprocess replica of three hard-killed
+      mid-storm at the replica-kill scenario's seeded instant:
+      every request still terminates 200 and the router books
+      balance (zero loss);
+    * **reshard** — after retiring one of four replicas, a re-scan
+      of the warmed digest set still serves >= 55% warm memo hits:
+      consistent hashing kept the surviving shards' memo warm.
+    """
+    import hashlib
+    import threading
+    import uuid
+
+    from trivy_tpu.faults import FaultInjector, parse_fault_spec
+    from trivy_tpu.router.core import SCAN_PATH, ScanRouter
+    from trivy_tpu.router.metrics import ROUTER_METRICS
+    from trivy_tpu.router.scaler import SubprocessReplicaController
+    from trivy_tpu.router.sim import SimReplica
+
+    out: dict = {}
+
+    def digests(n, seed):
+        return ["sha256:" + hashlib.sha256(
+            f"{seed}:{i}".encode()).hexdigest() for i in range(n)]
+
+    def scan_raw(digest):
+        return json.dumps(
+            {"idempotency_key": uuid.uuid4().hex,
+             "target": f"img:{digest[7:19]}",
+             "artifact_id": "sha256:art-" + digest[-12:],
+             "blob_ids": [digest]}).encode()
+
+    def storm(router, keys, n_threads):
+        statuses, lock = [], threading.Lock()
+        kill_cb = getattr(storm, "kill_cb", None)
+
+        def worker(chunk):
+            for d in chunk:
+                status, _, _ = router.route(SCAN_PATH, scan_raw(d))
+                with lock:
+                    statuses.append(status)
+                if kill_cb is not None:
+                    kill_cb()
+
+        threads = [threading.Thread(target=worker,
+                                    args=(keys[i::n_threads],))
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return statuses, time.perf_counter() - t0
+
+    # ------- arm 1: routed findings == direct findings -------
+    from trivy_tpu.db import AdvisoryStore
+    from trivy_tpu.router.front import RouterServer, serve_router
+    from trivy_tpu.rpc.client import RemoteCache, RemoteScanner
+    from trivy_tpu.rpc.server import ScanServer, serve
+    from trivy_tpu.scan.local import ScanTarget
+    from trivy_tpu.types import ScanOptions
+    from trivy_tpu.types.artifact import (OS, BlobInfo, Package,
+                                          PackageInfo)
+
+    def parity_store():
+        store = AdvisoryStore()
+        store.put_advisory("alpine 3.9", "musl", "CVE-2019-14697",
+                           {"FixedVersion": "1.1.20-r5"})
+        store.put_vulnerability("CVE-2019-14697",
+                                {"Title": "musl bug",
+                                 "Severity": "CRITICAL"})
+        return store
+
+    ROUTER_METRICS.reset()
+    servers, replicas = [], []
+    front = None
+    httpd_r = None
+    try:
+        for i in range(2):
+            srv = ScanServer(store=parity_store(), token="bench")
+            httpd, _ = serve(port=0, server=srv)
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            servers.append((srv, httpd, url))
+            replicas.append((f"r{i}", url))
+        router = ScanRouter(replicas, token="bench")
+        front = RouterServer(router, token="bench")
+        httpd_r, _ = serve_router(front, port=0)
+        router_url = f"http://127.0.0.1:{httpd_r.server_address[1]}"
+        blob = BlobInfo(
+            os=OS(family="alpine", name="3.9.4"),
+            package_infos=[PackageInfo(packages=[
+                Package(name="musl", version="1.1.20",
+                        release="r4", src_name="musl",
+                        src_version="1.1.20", src_release="r4")])])
+        for _, _, url in servers:
+            RemoteCache(url, token="bench",
+                        max_retries=2).put_blob("sha256:blob1",
+                                                blob)
+        target = ScanTarget(name="img:1",
+                            artifact_id="sha256:art1",
+                            blob_ids=["sha256:blob1"])
+        opts = ScanOptions(security_checks=["vuln"], backend="cpu")
+        direct = RemoteScanner(servers[0][2], token="bench",
+                               max_retries=2).scan(target, opts)
+        routed = RemoteScanner(router_url, token="bench",
+                               max_retries=2).scan(target, opts)
+        direct_doc = json.dumps([r.to_dict() for r in direct[0]],
+                                sort_keys=True)
+        routed_doc = json.dumps([r.to_dict() for r in routed[0]],
+                                sort_keys=True)
+        assert routed_doc == direct_doc, \
+            "router changed the findings"
+        out["routed_byte_identical"] = True
+    finally:
+        if httpd_r is not None:
+            httpd_r.shutdown()
+        if front is not None:
+            front.close()
+        for _, httpd, _ in servers:
+            httpd.shutdown()
+
+    # ------- arm 2: throughput scales with the replica count -----
+    N_FLEET, N_REQS, N_CLIENTS = 4, 240, 16
+    walls = {}
+    for n in (1, N_FLEET):
+        ROUTER_METRICS.reset()
+        sims = [SimReplica(name=f"b{i}", service_ms=15.0,
+                           max_concurrent=2).start()
+                for i in range(n)]
+        try:
+            router = ScanRouter([(s.name, s.url) for s in sims])
+            statuses, wall = storm(router,
+                                   digests(N_REQS, f"thr{n}"),
+                                   N_CLIENTS)
+            assert sorted(set(statuses)) == [200], \
+                f"non-200 in scaling arm: {set(statuses)}"
+            walls[n] = wall
+            if n == N_FLEET:
+                hists = ROUTER_METRICS.hist_snapshot()
+                route_sum = hists["route_latency"]["sum"]
+                up_sum = hists["upstream_latency"]["sum"]
+                overhead = (route_sum - up_sum) / max(1e-9,
+                                                      route_sum)
+                snap = ROUTER_METRICS.snapshot()
+                assert snap["lost"] == 0, snap
+        finally:
+            for s in sims:
+                s.stop()
+    speedup = walls[1] / max(1e-9, walls[N_FLEET])
+    out["fleet_replicas"] = N_FLEET
+    out["single_replica_rps"] = round(N_REQS / walls[1], 1)
+    out["fleet_rps"] = round(N_REQS / walls[N_FLEET], 1)
+    out["throughput_speedup"] = round(speedup, 2)
+    assert speedup >= 0.8 * N_FLEET, \
+        (f"router fleet speedup {speedup:.2f}x < "
+         f"{0.8 * N_FLEET:.1f}x at N={N_FLEET}")
+    out["router_overhead_share"] = round(overhead, 5)
+    assert overhead < 0.02, \
+        f"attributed router overhead {overhead:.2%} >= 2%"
+
+    # ------- arm 3: kill one replica mid-storm, zero loss -------
+    ROUTER_METRICS.reset()
+    inj = FaultInjector(parse_fault_spec(
+        "replica-kill:replica_kill_after=40"))
+    ctrl = SubprocessReplicaController(
+        prefix="kb", extra_args=["--service-ms", "5",
+                                 "--max-concurrent", "8"])
+    try:
+        router = ScanRouter(fault_injector=inj)
+        names = []
+        for _ in range(3):
+            name, url = ctrl.start()
+            router.add_replica(name, url)
+            names.append(name)
+        killed = threading.Event()
+
+        def kill_cb():
+            if inj.replica_kill_due(
+                    inj.counters["routed_forwards"]) \
+                    and not killed.is_set():
+                killed.set()
+                ctrl.kill(names[0])
+
+        storm.kill_cb = kill_cb
+        statuses, wall = storm(router, digests(120, "kill"), 8)
+        del storm.kill_cb
+        assert killed.is_set(), "kill never fired"
+        snap = ROUTER_METRICS.snapshot()
+        assert sorted(set(statuses)) == [200], \
+            f"lost requests in kill storm: {set(statuses)}"
+        assert snap["accepted"] == 120 == snap["ok"], snap
+        assert snap["lost"] == 0, snap
+        assert snap["conn_errors"] >= 1, snap
+        out["kill_storm_zero_loss"] = True
+        out["kill_storm_failovers"] = snap["failovers"]
+        out["kill_storm_replays"] = snap["replays"]
+        out["kill_storm_wall_s"] = round(wall, 2)
+    finally:
+        if hasattr(storm, "kill_cb"):
+            del storm.kill_cb
+        for name in list(ctrl.procs):
+            ctrl.stop(name)
+
+    # ------- arm 4: reshard keeps survivor shards memo-warm ------
+    ROUTER_METRICS.reset()
+    sims = [SimReplica(name=f"w{i}", service_ms=0.0).start()
+            for i in range(4)]
+    try:
+        router = ScanRouter([(s.name, s.url) for s in sims])
+        keys = digests(200, "warm")
+        statuses, _ = storm(router, keys, 8)
+        assert sorted(set(statuses)) == [200]
+        router.remove_replica("w3")
+        hits = 0
+        for d in keys:
+            status, body, _ = router.route(SCAN_PATH, scan_raw(d))
+            assert status == 200
+            hits += 1 if json.loads(body)["memo_hit"] else 0
+        rate = hits / len(keys)
+        out["post_reshard_warm_hit_rate"] = round(rate, 4)
+        assert rate >= 0.55, \
+            f"post-reshard warm hit rate {rate:.2%} < 55%"
+        assert ROUTER_METRICS.snapshot()["lost"] == 0
+    finally:
+        for s in sims:
+            s.stop()
+    ROUTER_METRICS.reset()
+    return out
+
+
 def _run_config(cfg: str) -> dict:
     return {"images": bench_images, "sboms": bench_sboms,
             "mesh": bench_mesh_scaling,
@@ -2498,7 +2738,8 @@ def _run_config(cfg: str) -> dict:
             "fleet-warm": bench_fleet_warm,
             "fleet-obs": bench_fleet_obs,
             "watch": bench_watch,
-            "witness": bench_witness}[cfg]()
+            "witness": bench_witness,
+            "router": bench_router}[cfg]()
 
 
 def _subprocess_config(cfg: str) -> dict:
@@ -2551,6 +2792,7 @@ def main() -> None:
     fleet_obs = _subprocess_config("fleet-obs")
     watch = _subprocess_config("watch")
     witness = _subprocess_config("witness")
+    router = _subprocess_config("router")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -2582,6 +2824,7 @@ def main() -> None:
         "fleet_obs": fleet_obs,
         "watch": watch,
         "witness": witness,
+        "router": router,
     }))
 
 

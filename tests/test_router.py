@@ -1,0 +1,995 @@
+"""Scan-router suite (docs/serving.md "Scan router & autoscaling").
+
+``pytest -m router`` — the fault-tolerant fleet front:
+
+* ring determinism, distribution and the bounded-load spill
+  (property tests on seeded digest sets);
+* reshard movement ≤ K/N: removing a replica moves ONLY its keys;
+* zero-loss failover: kill-one-replica-mid-storm books balance under
+  the lock witness, idempotent replay gives exactly one client
+  result per request;
+* drain-aware failover end-to-end over real HTTP against real
+  ScanServers, with routed findings byte-identical to direct ones;
+* tenant 429 passthrough (Retry-After reaches the offending client
+  untouched);
+* the ``/healthz`` contract: ``draining`` flips before the listener
+  closes, ``inflight`` counts live Scan RPCs;
+* the client satellites: 503 Retry-After honored like a 429's, the
+  serving replica surfaced on ``last_routed_replica``;
+* the SLO-driven autoscaler: pure decide() matrix plus the
+  drain-before-kill lifecycle;
+* the replica-kill / replica-flaky fault scenarios and the
+  ``trivy_tpu_router_*`` exposition.
+"""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trivy_tpu.artifact.resilient import CLOSED, CircuitBreaker
+from trivy_tpu.faults import parse_fault_spec
+from trivy_tpu.router.core import (ROUTED_REPLICA_HEADER, SCAN_PATH,
+                                   HealthProber, ScanRouter)
+from trivy_tpu.router.front import RouterServer, serve_router
+from trivy_tpu.router.metrics import ROUTER_METRICS
+from trivy_tpu.router.ring import Ring, movement
+from trivy_tpu.router.scaler import (Autoscaler, ScalerPolicy,
+                                     SimReplicaController,
+                                     SubprocessReplicaController,
+                                     decide)
+from trivy_tpu.router.sim import SimReplica
+from trivy_tpu.rpc.server import DEFAULT_TOKEN_HEADER, TENANT_HEADER
+
+pytestmark = pytest.mark.router
+
+
+# ---------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------
+
+def _keys(n, seed="ring"):
+    """Seeded, deterministic layer-digest population."""
+    import hashlib
+    return ["sha256:"
+            + hashlib.sha256(f"{seed}:{i}".encode()).hexdigest()
+            for i in range(n)]
+
+
+def _scan_body(digest, tenant="", key=None):
+    body = {"idempotency_key": key or uuid.uuid4().hex,
+            "target": f"img:{digest[7:19]}",
+            "artifact_id": "sha256:art-" + digest[-12:],
+            "blob_ids": [digest]}
+    if tenant:
+        body["tenant"] = tenant
+    return body
+
+
+def _digest_owned_by(ring, node, seed="own"):
+    for k in _keys(512, seed):
+        if ring.owner(k) == node:
+            return k
+    raise AssertionError(f"no seeded key owned by {node}")
+
+
+def _post(url, path, body, headers=None):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url + path, data=data, method="POST",
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            return (resp.status, json.loads(resp.read() or b"{}"),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _get(url, path, headers=None, raw=False):
+    req = urllib.request.Request(url + path, method="GET",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            data = resp.read()
+            return (resp.status,
+                    data if raw else json.loads(data or b"{}"))
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, data if raw else json.loads(data or b"{}")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_router_metrics():
+    ROUTER_METRICS.reset()
+    yield
+    ROUTER_METRICS.reset()
+
+
+@pytest.fixture()
+def fleet():
+    """fleet(n, **sim_kwargs) -> n started SimReplicas s0..s{n-1},
+    stopped on teardown."""
+    sims = []
+
+    def make(n, **kw):
+        for i in range(n):
+            sims.append(SimReplica(name=f"s{i}", **kw).start())
+        return sims
+
+    yield make
+    for s in sims:
+        s.stop()
+
+
+def _router_for(sims, **kw):
+    return ScanRouter([(s.name, s.url) for s in sims], **kw)
+
+
+class _ScriptedReplica:
+    """Minimal HTTP backend answering a scripted sequence of
+    (status, payload, headers) per POST; the last entry repeats."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length")
+                             or 0)
+                outer.requests.append(
+                    (self.path, self.rfile.read(length)))
+                idx = min(len(outer.requests) - 1,
+                          len(outer.script) - 1)
+                status, payload, headers = outer.script[idx]
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# ---------------------------------------------------------------
+# ring: determinism, distribution, bounded load, reshard bound
+# ---------------------------------------------------------------
+
+class TestRing:
+    def test_deterministic_across_instances(self):
+        a, b = Ring(), Ring()
+        for node in ("r0", "r1", "r2", "r3"):
+            a.add(node)
+        for node in ("r3", "r1", "r0", "r2"):    # insertion order
+            b.add(node)                          # must not matter
+        for k in _keys(300, "det"):
+            assert a.owner(k) == b.owner(k)
+            assert a.walk(k) == b.walk(k)
+
+    def test_distribution_no_melted_shard(self):
+        ring = Ring()
+        for node in ("r0", "r1", "r2", "r3"):
+            ring.add(node)
+        counts = {n: 0 for n in ring.nodes()}
+        keys = _keys(2000, "dist")
+        for k in keys:
+            counts[ring.owner(k)] += 1
+        for n, c in counts.items():
+            share = c / len(keys)
+            assert 0.10 < share < 0.45, (n, share)
+
+    def test_walk_is_total_failover_order(self):
+        ring = Ring()
+        for node in ("r0", "r1", "r2"):
+            ring.add(node)
+        for k in _keys(50, "walk"):
+            w = ring.walk(k)
+            assert sorted(w) == ["r0", "r1", "r2"]
+            assert w[0] == ring.owner(k)
+
+    def test_capacity_formula(self):
+        ring = Ring(capacity_factor=1.25)
+        for node in ("r0", "r1", "r2"):
+            ring.add(node)
+        loads = {"r0": 10, "r1": 4, "r2": 0}
+        assert ring.capacity(loads) == \
+            math.ceil(1.25 * (14 + 1) / 3)
+        assert ring.capacity({}) == 1
+        assert Ring().capacity({"r0": 5}) == 0   # empty ring
+
+    def test_bounded_load_spills_past_hot_owner(self):
+        ring = Ring()
+        for node in ("r0", "r1", "r2"):
+            ring.add(node)
+        key = _keys(1, "hot")[0]
+        owner = ring.owner(key)
+        loads = {n: 0 for n in ring.nodes()}
+        loads[owner] = 50                 # the melted shard
+        got = ring.assign(key, loads)
+        assert got != owner
+        assert got == ring.walk(key)[1]   # spill = NEXT ring owner
+
+    def test_assign_exclude_and_empty_cases(self):
+        ring = Ring()
+        assert ring.walk("k") == [] and ring.owner("k") is None
+        for node in ("r0", "r1"):
+            ring.add(node)
+        assert ring.assign("k", {}, exclude={"r0", "r1"}) is None
+        only = ring.assign("k", {}, exclude={ring.owner("k")})
+        assert only is not None and only != ring.owner("k")
+
+    def test_all_saturated_falls_back_to_least_loaded(self):
+        ring = Ring()
+        for node in ("r0", "r1", "r2"):
+            ring.add(node)
+        # cap = ceil(1.25 * 111 / 3) = 47: both eligible nodes sit
+        # over it, so assign falls back to the least loaded instead
+        # of refusing (admission control lives on the replicas)
+        loads = {"r0": 0, "r1": 50, "r2": 60}
+        assert ring.assign("some-key", loads,
+                           exclude={"r0"}) == "r1"
+
+    def test_remove_moves_only_the_dead_nodes_keys(self):
+        keys = _keys(400, "reshard")
+        for n in (3, 5, 8):
+            names = [f"r{i}" for i in range(n)]
+            before, after = Ring(), Ring()
+            for name in names:
+                before.add(name)
+                if name != "r1":
+                    after.add(name)
+            dead_share = sum(1 for k in keys
+                             if before.owner(k) == "r1") / len(keys)
+            # keys owned by survivors NEVER move
+            for k in keys:
+                if before.owner(k) != "r1":
+                    assert after.owner(k) == before.owner(k)
+            moved = movement(keys, before, after)
+            assert moved == pytest.approx(dead_share)
+            assert moved <= 2.0 / n       # ~K/N with vnode variance
+
+    def test_add_moves_keys_only_to_the_new_node(self):
+        keys = _keys(400, "grow")
+        before, after = Ring(), Ring()
+        for name in ("r0", "r1", "r2"):
+            before.add(name)
+            after.add(name)
+        after.add("r3")
+        for k in keys:
+            if after.owner(k) != "r3":
+                assert after.owner(k) == before.owner(k)
+        assert movement(keys, before, after) <= 2.0 / 4
+
+
+# ---------------------------------------------------------------
+# router core: routing, affinity, spill, drain, failover, tenants
+# ---------------------------------------------------------------
+
+class TestRouterCore:
+    def test_scan_routed_stamped_and_booked(self, fleet):
+        sims = fleet(2, service_ms=0)
+        r = _router_for(sims)
+        digest = _keys(1, "core")[0]
+        status, out, extra = r.route(
+            SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+        assert status == 200
+        doc = json.loads(out)
+        # idle fleet: the plain ring owner serves, and both the
+        # response body and the header say which replica that was
+        assert doc["routed_replica"] == r.ring.owner(digest)
+        assert dict(extra)[ROUTED_REPLICA_HEADER] == \
+            doc["routed_replica"]
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["accepted"] == 1 == snap["ok"]
+        assert snap["lost"] == 0 and snap["failovers"] == 0
+
+    def test_keyless_scan_gets_minted_idempotency_key(self, fleet):
+        sims = fleet(1, service_ms=0)
+        r = _router_for(sims)
+        body = {"target": "img:x", "blob_ids": _keys(1, "mint")}
+        status, _, _ = r.route(SCAN_PATH, json.dumps(body).encode())
+        assert status == 200
+        # replay safety for raw-curl clients: the router minted the
+        # key, so the replica's idempotency window has the entry
+        assert len(sims[0]._idem) == 1
+
+    def test_affinity_follows_the_cache_session(self, fleet):
+        sims = fleet(3, service_ms=0)
+        r = _router_for(sims)
+        base, layer = _keys(2, "aff")
+        status, _, _ = r.route(
+            "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+            json.dumps({"artifact_id": "sha256:artA",
+                        "blob_ids": [base, layer]}).encode())
+        assert status == 200
+        # the session's follow-up traffic recalls the SAME route key
+        assert r.route_key("/twirp/trivy.cache.v1.Cache/PutBlob",
+                           {"diff_id": layer}) == base
+        assert r.route_key("/twirp/trivy.cache.v1.Cache/PutArtifact",
+                           {"artifact_id": "sha256:artA"}) == base
+        assert r.route_key("/twirp/trivy.cache.v1.Cache/DeleteBlobs",
+                           {"blob_ids": [layer]}) == base
+
+    def test_bounded_load_spill_on_the_request_path(self, fleet):
+        sims = fleet(2, service_ms=0)
+        r = _router_for(sims)
+        digest = _keys(1, "spill")[0]
+        owner = r.ring.owner(digest)
+        r.replica(owner).inflight = 20    # melted shard, simulated
+        status, out, _ = r.route(
+            SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+        assert status == 200
+        assert json.loads(out)["routed_replica"] != owner
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["spills"] == 1 and snap["ok"] == 1
+
+    def test_drain_failover_is_overlay_not_reshard(self, fleet):
+        sims = fleet(2, service_ms=0)
+        r = _router_for(sims)
+        sim_by = {s.name: s for s in sims}
+        digest = _digest_owned_by(r.ring, "s0", "drain")
+        sim_by["s0"].drain()
+        status, out, _ = r.route(
+            SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+        assert status == 200
+        doc = json.loads(out)
+        assert doc["routed_replica"] == "s1" and doc["replayed"]
+        assert r.replica("s0").draining is True
+        # overlay, not membership: the ring still has both nodes,
+        # so finishing the drain costs ZERO extra reshard movement
+        assert r.ring.nodes() == ["s0", "s1"]
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["drain_redirects"] == 1
+        assert snap["failovers"] == 1 == snap["replays"]
+        # the drain is now known: the next request routes straight
+        # to s1 without touching the draining replica again
+        status, out, _ = r.route(
+            SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+        assert status == 200
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["drain_redirects"] == 1     # unchanged
+        assert snap["accepted"] == 2 == snap["ok"]
+        assert snap["lost"] == 0
+
+    def test_conn_failover_replays_with_same_key(self, fleet):
+        sims = fleet(2, service_ms=0)
+        r = _router_for(sims)
+        digest = _digest_owned_by(r.ring, "s0", "dead")
+        {s.name: s for s in sims}["s0"].stop()
+        idem = uuid.uuid4().hex
+        status, out, _ = r.route(
+            SCAN_PATH,
+            json.dumps(_scan_body(digest, key=idem)).encode())
+        assert status == 200
+        doc = json.loads(out)
+        assert doc["routed_replica"] == "s1" and doc["replayed"]
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["conn_errors"] >= 1
+        assert snap["failovers"] == 1 == snap["replays"]
+        assert snap["ok"] == 1 and snap["lost"] == 0
+
+    def test_tenant_429_passes_through_untouched(self, fleet):
+        sims = fleet(1, service_ms=0, tenant_rate=1.0)
+        r = _router_for(sims)
+        digest = _keys(1, "tenant")[0]
+        hdrs = {TENANT_HEADER: "flooder"}
+        status, _, _ = r.route(
+            SCAN_PATH, json.dumps(_scan_body(digest)).encode(),
+            hdrs)
+        assert status == 200
+        status, out, extra = r.route(
+            SCAN_PATH, json.dumps(_scan_body(digest)).encode(),
+            hdrs)
+        assert status == 429
+        doc = json.loads(out)
+        assert doc["code"] == "rate_limited"
+        assert doc["retry_after_s"] > 0
+        assert "Retry-After" in dict(extra)
+        snap = ROUTER_METRICS.snapshot()
+        # terminal passthrough: a tenant verdict is NOT a router
+        # retry — no failover, books balanced
+        assert snap["failovers"] == 0
+        assert snap["ok"] == 1 == snap["rate_limited"]
+        assert snap["lost"] == 0
+
+    def test_fleet_wide_drain_yields_router_503(self, fleet):
+        sims = fleet(2, service_ms=0)
+        for s in sims:
+            s.drain()
+        r = _router_for(sims)
+        status, out, extra = r.route(
+            SCAN_PATH,
+            json.dumps(_scan_body(_keys(1, "x")[0])).encode())
+        assert status == 503
+        doc = json.loads(out)
+        assert doc["code"] == "unavailable"
+        assert doc["retry_after_s"] > 0
+        assert "Retry-After" in dict(extra)
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["unavailable"] == 1 == snap["accepted"]
+        assert snap["drain_redirects"] == 2 and snap["lost"] == 0
+
+    def test_saturated_503_spills_then_exhausts(self):
+        stub = _ScriptedReplica(
+            [(503, {"code": "resource_exhausted",
+                    "retry_after_s": 0.25}, [])])
+        try:
+            r = ScanRouter([("stub", stub.url)])
+            status, out, extra = r.route(
+                SCAN_PATH,
+                json.dumps(_scan_body(_keys(1, "sat")[0])).encode())
+            assert status == 503
+            doc = json.loads(out)
+            # the upstream's shed hint survives into the router's
+            # own 503 once every owner is saturated
+            assert doc["code"] == "unavailable"
+            assert doc["retry_after_s"] == 0.25
+            assert "Retry-After" in dict(extra)
+            snap = ROUTER_METRICS.snapshot()
+            assert snap["spills"] == 1
+            assert snap["unavailable"] == 1 and snap["lost"] == 0
+        finally:
+            stub.stop()
+
+
+# ---------------------------------------------------------------
+# prober: ejection on death, recovery after restart
+# ---------------------------------------------------------------
+
+class TestHealthProber:
+    def test_eject_dead_replica_then_recover(self, fleet):
+        sims = fleet(2, service_ms=0)
+        r = _router_for(sims)
+        prober = HealthProber(r, timeout_s=0.5)
+        prober.probe_once()
+        assert all(h.probe_ok for h in r.replicas())
+        assert r.replica("s0").build.get("sim") is True
+        # fast breaker so the test never waits on real cooldowns
+        r.replica("s0").breaker = CircuitBreaker(
+            fail_threshold=2, cooldown_s=0.05)
+        port = sims[0].port
+        sims[0].stop()
+        for _ in range(4):
+            prober.probe_once()
+            if r.replica("s0").breaker.state != CLOSED:
+                break
+        assert r.replica("s0").breaker.state != CLOSED
+        assert "s0" not in r.stats()["routable"]
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["ejections"] == 1 and snap["probe_failures"] >= 2
+        # requests keep landing on the survivor meanwhile
+        status, out, _ = r.route(
+            SCAN_PATH,
+            json.dumps(_scan_body(_keys(1, "pr")[0])).encode())
+        assert status == 200
+        assert json.loads(out)["routed_replica"] == "s1"
+        # replica comes back on the same endpoint: the half-open
+        # probe (owned by the prober, never a client request)
+        # closes the breaker again
+        revived = SimReplica(name="s0", port=port).start()
+        try:
+            time.sleep(0.06)                  # past the cooldown
+            prober.probe_once()
+            assert r.replica("s0").breaker.state == CLOSED
+            assert "s0" in r.stats()["routable"]
+            assert ROUTER_METRICS.snapshot()["recoveries"] == 1
+        finally:
+            revived.stop()
+
+
+# ---------------------------------------------------------------
+# zero-loss: kill one replica mid-storm (subprocess fleet, witness)
+# ---------------------------------------------------------------
+
+class TestKillMidStorm:
+    def test_books_balance_through_replica_death(self, lock_witness,
+                                                 make_faults):
+        inj = make_faults("replica-kill:replica_kill_after=24")
+        ctrl = SubprocessReplicaController(
+            prefix="krep",
+            extra_args=["--service-ms", "4",
+                        "--max-concurrent", "8"])
+        router = ScanRouter(fault_injector=inj)
+        names = []
+        try:
+            for _ in range(3):
+                name, url = ctrl.start()
+                router.add_replica(name, url)
+                names.append(name)
+            victim = names[0]
+            killed = threading.Event()
+            statuses = []
+            res_lock = threading.Lock()
+            keys = _keys(72, "storm")
+
+            def worker(chunk):
+                for digest in chunk:
+                    status, _, _ = router.route(
+                        SCAN_PATH,
+                        json.dumps(_scan_body(digest)).encode())
+                    with res_lock:
+                        statuses.append(status)
+                    if inj.replica_kill_due(
+                            inj.counters["routed_forwards"]) \
+                            and not killed.is_set():
+                        killed.set()
+                        ctrl.kill(victim)
+
+            threads = [threading.Thread(target=worker,
+                                        args=(keys[i::6],))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            assert killed.is_set()
+            assert inj.counters["replica_kills"] == 1
+            # zero loss: every request in the storm ended 200 even
+            # though a replica died under it
+            assert sorted(set(statuses)) == [200]
+            snap = ROUTER_METRICS.snapshot()
+            assert snap["accepted"] == 72 == snap["ok"]
+            assert snap["lost"] == 0
+            assert snap["conn_errors"] >= 1
+            assert snap["failovers"] >= 1 and snap["replays"] >= 1
+        finally:
+            for name in list(ctrl.procs):
+                ctrl.stop(name)
+
+
+# ---------------------------------------------------------------
+# idempotent replay via the flaky-replica fault scenario
+# ---------------------------------------------------------------
+
+class TestRouteFaultScenarios:
+    def test_scenarios_parse(self):
+        spec = parse_fault_spec("replica-kill")
+        assert spec.replica_kill_after == 32
+        assert spec.wants_route_faults()
+        spec = parse_fault_spec(
+            "replica-flaky:replica_flaky_every=2,replica_flaky=r1")
+        assert spec.replica_flaky_every == 2
+        assert spec.replica_flaky == "r1"
+        assert spec.wants_route_faults()
+        assert not parse_fault_spec("").wants_route_faults()
+
+    def test_on_route_forward_drop_cadence(self, make_faults):
+        inj = make_faults("replica-flaky:replica_flaky_every=2")
+        got = [inj.on_route_forward("rX") for _ in range(6)]
+        assert got == ["ok", "drop"] * 3
+        assert inj.counters["routed_forwards"] == 6
+        assert inj.counters["route_drops"] == 3
+
+    def test_scoped_drop_only_hits_named_replica(self, make_faults):
+        inj = make_faults(
+            "replica-flaky:replica_flaky_every=1,replica_flaky=r1")
+        assert inj.on_route_forward("r0") == "ok"
+        assert inj.on_route_forward("r1") == "drop"
+        assert inj.counters["route_drops"] == 1
+
+    def test_replica_kill_due_fires_exactly_once(self, make_faults):
+        inj = make_faults("replica-kill:replica_kill_after=3")
+        assert not inj.replica_kill_due(2)
+        assert inj.replica_kill_due(3)
+        assert not inj.replica_kill_due(4)
+        assert inj.counters["replica_kills"] == 1
+
+    def test_flaky_replay_yields_exactly_one_result(self, fleet,
+                                                    make_faults):
+        sims = fleet(2, service_ms=0)
+        inj = make_faults("replica-flaky")   # drop every 3rd forward
+        r = _router_for(sims, fault_injector=inj)
+        statuses = []
+        for digest in _keys(9, "flaky"):
+            status, out, _ = r.route(
+                SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+            statuses.append(status)
+            doc = json.loads(out)
+            assert doc["results"] == []
+            assert doc["replica"] in ("s0", "s1")
+        # every request terminated in exactly one 200 at the client
+        assert statuses == [200] * 9
+        snap = ROUTER_METRICS.snapshot()
+        assert inj.counters["route_drops"] >= 2
+        assert snap["replays"] == inj.counters["route_drops"]
+        assert snap["ok"] == 9 and snap["lost"] == 0
+        # the dropped work DID run (then got replayed elsewhere):
+        # the fleet paid for it, the client never saw a duplicate
+        total = sum(s.counters["scans"] for s in sims)
+        assert total == 9 + inj.counters["route_drops"]
+
+
+# ---------------------------------------------------------------
+# /healthz contract on the real ScanServer (server satellite)
+# ---------------------------------------------------------------
+
+class TestHealthzContract:
+    def test_draining_flips_before_listener_closes(self):
+        from tests.test_rpc import _store
+        from trivy_tpu.rpc.server import ScanServer, serve
+        srv = ScanServer(store=_store())
+        httpd, _ = serve(port=0, server=srv)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            status, doc = _get(url, "/healthz")
+            assert status == 200 and doc["status"] == "ok"
+            assert doc["draining"] is False
+            assert doc["inflight"] == 0 and doc["build"]
+            srv.begin_drain()
+            # the listener is still up and says so — a router sees
+            # the flag BEFORE any drain 503 ever fires
+            status, doc = _get(url, "/healthz")
+            assert status == 200 and doc["status"] == "draining"
+            assert doc["draining"] is True
+            status, doc, _ = _post(
+                url, SCAN_PATH, _scan_body(_keys(1, "d")[0]))
+            assert status == 503 and doc["code"] == "unavailable"
+        finally:
+            httpd.shutdown()
+
+    def test_inflight_counts_live_scans(self):
+        from tests.test_rpc import _store
+        from trivy_tpu.rpc.server import ScanServer, serve
+        srv = ScanServer(store=_store())
+        gate = threading.Event()
+
+        def slow(body):
+            gate.wait(5.0)
+            return {"results": [],
+                    "os": {"family": "alpine", "name": "3.9.4"}}
+
+        srv._scan_idempotent = slow
+        httpd, _ = serve(port=0, server=srv)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        t = threading.Thread(
+            target=_post,
+            args=(url, SCAN_PATH, _scan_body(_keys(1, "i")[0])),
+            daemon=True)
+        try:
+            t.start()
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                _, doc = _get(url, "/healthz")
+                if doc["inflight"] == 1:
+                    break
+                time.sleep(0.01)
+            assert doc["inflight"] == 1
+            gate.set()
+            t.join(timeout=5.0)
+            _, doc = _get(url, "/healthz")
+            assert doc["inflight"] == 0
+        finally:
+            gate.set()
+            httpd.shutdown()
+
+
+# ---------------------------------------------------------------
+# client satellites: 503 Retry-After honored, replica surfaced
+# ---------------------------------------------------------------
+
+class TestClientSatellites:
+    def test_503_body_hint_preferred_over_header(self, monkeypatch):
+        from trivy_tpu.rpc import client as client_mod
+        from trivy_tpu.rpc.client import RemoteCache
+        stub = _ScriptedReplica([
+            (503, {"code": "unavailable", "retry_after_s": 0.03},
+             [("Retry-After", "7")]),
+            (200, {"missing_artifact": False,
+                   "missing_blob_ids": []}, []),
+        ])
+        sleeps = []
+        monkeypatch.setattr(client_mod.time, "sleep", sleeps.append)
+        try:
+            # huge jitter base: if the hint were ignored the test
+            # would record a multi-second delay instead
+            c = RemoteCache(stub.url, max_retries=3,
+                            backoff_base_s=33.0, backoff_max_s=44.0)
+            missing_artifact, missing = c.missing_blobs("a", ["b"])
+            assert missing_artifact is False and missing == []
+            assert sleeps == [0.03]
+            assert c.counters["retries"] == 1
+        finally:
+            stub.stop()
+
+    def test_503_header_fallback(self, monkeypatch):
+        from trivy_tpu.rpc import client as client_mod
+        from trivy_tpu.rpc.client import RemoteCache
+        stub = _ScriptedReplica([
+            (503, {"code": "unavailable"}, [("Retry-After", "1")]),
+            (200, {"missing_artifact": True,
+                   "missing_blob_ids": ["b"]}, []),
+        ])
+        sleeps = []
+        monkeypatch.setattr(client_mod.time, "sleep", sleeps.append)
+        try:
+            c = RemoteCache(stub.url, max_retries=3,
+                            backoff_base_s=33.0, backoff_max_s=44.0)
+            missing_artifact, missing = c.missing_blobs("a", ["b"])
+            assert missing_artifact is True and missing == ["b"]
+            assert sleeps == [1.0]
+        finally:
+            stub.stop()
+
+    def test_routed_replica_surfaced_on_scan(self):
+        from trivy_tpu.rpc.client import RemoteScanner
+        from trivy_tpu.scan.local import ScanTarget
+        from trivy_tpu.types import ScanOptions
+        stub = _ScriptedReplica([
+            (200, {"results": [],
+                   "os": {"family": "sim", "name": "0"},
+                   "routed_replica": "r4"},
+             [("Trivy-Routed-Replica", "r4")]),
+        ])
+        try:
+            scanner = RemoteScanner(stub.url, max_retries=2)
+            results, os_found = scanner.scan(
+                ScanTarget(name="img:1", artifact_id="sha256:a",
+                           blob_ids=["sha256:b"]),
+                ScanOptions(security_checks=["vuln"],
+                            backend="cpu"))
+            assert results == []
+            assert scanner.last_routed_replica == "r4"
+        finally:
+            stub.stop()
+
+
+# ---------------------------------------------------------------
+# drain-aware failover e2e: real ScanServers behind the HTTP front
+# ---------------------------------------------------------------
+
+class TestDrainFailoverE2E:
+    def test_routed_byte_identical_and_drain_failover(self):
+        from tests.test_rpc import _blob, _store
+        from trivy_tpu.rpc.client import RemoteCache, RemoteScanner
+        from trivy_tpu.rpc.server import ScanServer, serve
+        from trivy_tpu.scan.local import ScanTarget
+        from trivy_tpu.types import ScanOptions
+        servers = []
+        replicas = []
+        for i in range(2):
+            srv = ScanServer(store=_store(), token="s3cret")
+            httpd, _ = serve(port=0, server=srv)
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            servers.append((srv, httpd, url))
+            replicas.append((f"r{i}", url))
+        router = ScanRouter(replicas, token="s3cret")
+        front = RouterServer(router, token="s3cret")
+        httpd_r, _ = serve_router(front, port=0)
+        router_url = \
+            f"http://127.0.0.1:{httpd_r.server_address[1]}"
+        try:
+            # warm BOTH replicas' caches so either can serve
+            for _, _, url in servers:
+                RemoteCache(url, token="s3cret", max_retries=2,
+                            backoff_base_s=0.01).put_blob(
+                                "sha256:blob1", _blob())
+            target = ScanTarget(name="img:1",
+                                artifact_id="sha256:art1",
+                                blob_ids=["sha256:blob1"])
+            opts = ScanOptions(security_checks=["vuln"],
+                               backend="cpu")
+
+            def ser(res):
+                return json.dumps([r.to_dict() for r in res[0]],
+                                  sort_keys=True)
+
+            direct = RemoteScanner(
+                servers[0][2], token="s3cret",
+                max_retries=2).scan(target, opts)
+            scanner = RemoteScanner(router_url, token="s3cret",
+                                    max_retries=4,
+                                    backoff_base_s=0.01)
+            routed = scanner.scan(target, opts)
+            assert scanner.last_routed_replica in ("r0", "r1")
+            assert routed[1].family == "alpine"
+            assert ser(routed) == ser(direct)
+            # drain the replica that served; the SAME client call
+            # shape fails over and the findings stay identical
+            serving = scanner.last_routed_replica
+            idx = int(serving[1:])
+            servers[idx][0].begin_drain()
+            routed2 = scanner.scan(target, opts)
+            assert scanner.last_routed_replica == f"r{1 - idx}"
+            assert ser(routed2) == ser(direct)
+            snap = ROUTER_METRICS.snapshot()
+            assert snap["drain_redirects"] >= 1
+            assert snap["lost"] == 0
+            # the prober reads the drain flag off the live listener
+            HealthProber(router).probe_once()
+            assert router.replica(serving).draining is True
+        finally:
+            httpd_r.shutdown()
+            front.close()
+            for _, httpd, _ in servers:
+                httpd.shutdown()
+
+
+# ---------------------------------------------------------------
+# reshard keeps the survivors' memo warm
+# ---------------------------------------------------------------
+
+class TestReshardWarmth:
+    def test_survivor_shards_stay_warm_after_reshard(self, fleet):
+        sims = fleet(3, service_ms=0)
+        r = _router_for(sims)
+        keys = _keys(60, "warm")
+        for digest in keys:
+            status, _, _ = r.route(
+                SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+            assert status == 200
+        before = Ring()
+        for s in sims:
+            before.add(s.name)
+        r.remove_replica("s2")
+        hits = 0
+        for digest in keys:
+            status, out, _ = r.route(
+                SCAN_PATH, json.dumps(_scan_body(digest)).encode())
+            assert status == 200
+            hits += 1 if json.loads(out)["memo_hit"] else 0
+        # exactly the dead replica's keys went cold — the minimal-
+        # movement guarantee measured as warm memo hits
+        expected = sum(1 for k in keys if before.owner(k) != "s2")
+        assert hits == expected
+        assert hits / len(keys) >= 0.55
+        assert ROUTER_METRICS.snapshot()["lost"] == 0
+
+
+# ---------------------------------------------------------------
+# autoscaler: pure decisions + drain-before-kill lifecycle
+# ---------------------------------------------------------------
+
+class TestScaler:
+    def test_decide_matrix(self):
+        p = ScalerPolicy(min_replicas=1, max_replicas=3,
+                         calm_ticks=2, low_inflight=0.5)
+        assert decide(False, True, 5.0, 2, 0, p)[0] == "up"
+        assert decide(False, True, 5.0, 3, 0, p)[0] == "hold"
+        assert decide(True, True, 0.0, 1, 9, p)[0] == "hold"
+        assert decide(True, True, 0.0, 2, 0, p)[0] == "hold"
+        assert decide(True, True, 0.0, 2, 1, p)[0] == "down"
+        assert decide(True, False, 0.0, 2, 5, p)[0] == "hold"
+        assert decide(True, True, 2.0, 2, 5, p)[0] == "hold"
+
+    def test_lifecycle_up_cooldown_then_drain_before_kill(self):
+        clk = {"t": 0.0}
+        ctrl = SimReplicaController(prefix="as", service_ms=0)
+        router = ScanRouter()
+        policy = ScalerPolicy(min_replicas=1, max_replicas=3,
+                              calm_ticks=2, cooldown_s=5.0,
+                              low_inflight=0.5)
+        scaler = Autoscaler(router, ctrl, policy=policy,
+                            clock=lambda: clk["t"])
+        try:
+            trip = {"slo_ok": False, "complete": True}
+            calm = {"slo_ok": True, "complete": True}
+            assert scaler.tick(trip)["action"] == "up"
+            assert len(router.replicas()) == 1
+            clk["t"] += 6.0
+            assert scaler.tick(trip)["action"] == "up"
+            assert len(router.replicas()) == 2
+            # flap damping: a trip inside the cooldown holds
+            ev = scaler.tick(trip)
+            assert ev["action"] == "hold"
+            assert "cooldown" in ev["reason"]
+            clk["t"] += 6.0
+            # calm + complete + idle: calm_ticks then a DRAIN
+            assert scaler.tick(calm)["action"] == "hold"
+            ev = scaler.tick(calm)
+            assert ev["action"] == "down"
+            victim = ev["draining"][0]
+            # never a kill: the victim is draining and still alive
+            assert router.replica(victim).draining is True
+            assert victim in ctrl.replicas
+            # quiesced (inflight 0): next tick stops + reshards
+            clk["t"] += 6.0
+            scaler.tick(calm)
+            assert router.replica(victim) is None
+            assert victim not in ctrl.replicas
+            assert len(router.replicas()) == 1
+            snap = ROUTER_METRICS.snapshot()
+            assert snap["scale_ups"] == 2
+            assert snap["scale_downs"] == 1
+            assert snap["drains_started"] == 1
+            assert snap["drain_kills"] == 1
+        finally:
+            for name in list(ctrl.replicas):
+                ctrl.stop(name)
+
+    def test_incomplete_federated_view_blocks_scale_down(self):
+        clk = {"t": 0.0}
+        ctrl = SimReplicaController(prefix="inc", service_ms=0)
+        router = ScanRouter()
+        for _ in range(2):
+            name, url = ctrl.start()
+            router.add_replica(name, url)
+        policy = ScalerPolicy(min_replicas=1, max_replicas=3,
+                              calm_ticks=1, cooldown_s=0.0,
+                              low_inflight=0.5)
+        scaler = Autoscaler(router, ctrl, policy=policy,
+                            clock=lambda: clk["t"])
+        try:
+            ev = scaler.tick({"slo_ok": True, "complete": False})
+            assert ev["action"] == "hold"
+            assert "incomplete" in ev["reason"]
+            assert len(router.replicas()) == 2
+        finally:
+            for name in list(ctrl.replicas):
+                ctrl.stop(name)
+
+
+# ---------------------------------------------------------------
+# HTTP front: auth, fleet view, Prometheus exposition
+# ---------------------------------------------------------------
+
+class TestFrontAndExposition:
+    def test_front_auth_health_replicas_and_metrics(self, fleet):
+        sims = fleet(2, service_ms=0)
+        router = _router_for(sims)
+        front = RouterServer(router, token="tok")
+        httpd, _ = serve_router(front, port=0)
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        auth = {DEFAULT_TOKEN_HEADER: "tok"}
+        try:
+            status, doc = _get(url, "/healthz")
+            assert status == 200 and doc["status"] == "ok"
+            assert doc["role"] == "router" and doc["routable"] == 2
+            status, _ = _get(url, "/metrics")
+            assert status == 401            # operational GET gated
+            status, doc, hdrs = _post(
+                url, SCAN_PATH, _scan_body(_keys(1, "fr")[0]),
+                headers=auth)
+            assert status == 200
+            assert hdrs.get(ROUTED_REPLICA_HEADER) == \
+                doc["routed_replica"]
+            status, doc = _get(url, "/replicas", headers=auth)
+            assert status == 200 and len(doc["replicas"]) == 2
+            assert doc["ring"]["nodes"] == ["s0", "s1"]
+            status, doc = _get(url, "/metrics", headers=auth)
+            assert status == 200
+            assert doc["router"]["accepted"] == 1
+            assert doc["router"]["lost"] == 0
+            status, text = _get(url, "/metrics",
+                                headers={**auth,
+                                         "Accept": "text/plain"},
+                                raw=True)
+            assert status == 200
+            text = text.decode()
+            assert "trivy_tpu_router_accepted_total 1" in text
+            assert ('trivy_tpu_router_requests_total'
+                    '{outcome="ok"} 1') in text
+            assert "trivy_tpu_router_replica_inflight" in text
+            assert ('trivy_tpu_router_latency_seconds_bucket'
+                    '{stage="route_latency"') in text
+            assert "trivy_tpu_router_lost 0" in text
+        finally:
+            httpd.shutdown()
+            front.close()

@@ -755,7 +755,8 @@ class BareExceptRule(Rule):
              "trivy_tpu/sched/", "trivy_tpu/runtime/",
              "trivy_tpu/artifact/", "trivy_tpu/memo/",
              "trivy_tpu/obs/", "trivy_tpu/guard/",
-             "trivy_tpu/faults/", "trivy_tpu/parallel/")
+             "trivy_tpu/faults/", "trivy_tpu/parallel/",
+             "trivy_tpu/router/")
 
     @staticmethod
     def _is_silent(handler: ast.ExceptHandler) -> bool:

@@ -532,6 +532,49 @@ def build_parser() -> argparse.ArgumentParser:
                      "shutdown; the always-on host profiler is "
                      "also served at GET /debug/profile?seconds=N")
 
+    rt = sub.add_parser("route", help="run the scan-router front: "
+                        "consistent-hash sharding over N server "
+                        "replicas with zero-loss failover and "
+                        "SLO-driven autoscaling (docs/serving.md)")
+    rt.add_argument("--listen", default="127.0.0.1:4955")
+    rt.add_argument("--replicas", default="",
+                    help="backend replicas, "
+                    "'name=http://host:port,...' (or bare URLs) — "
+                    "same syntax as --federate-peers; may be empty "
+                    "when --scaler brings the fleet up")
+    rt.add_argument("--token", dest="auth_token", default="",
+                    help="shared fleet token: required from "
+                    "clients AND presented to replicas")
+    rt.add_argument("--token-header", default="Trivy-Token")
+    rt.add_argument("--vnodes", type=int, default=64,
+                    help="virtual nodes per replica on the hash "
+                    "ring")
+    rt.add_argument("--capacity-factor", type=float, default=1.25,
+                    help="bounded-load cap: a replica takes at "
+                    "most ceil(cf * (inflight+1) / n) requests "
+                    "before the hot digest spills to the next "
+                    "ring owner")
+    rt.add_argument("--probe-interval", type=float, default=1.0,
+                    help="seconds between /healthz probes of each "
+                    "replica (drain visibility, breaker recovery)")
+    rt.add_argument("--upstream-timeout", type=float, default=300.0,
+                    help="per-forward upstream timeout in seconds; "
+                    "a timed-out replica is failed over with the "
+                    "same idempotency key")
+    rt.add_argument("--scaler", default="off",
+                    choices=["off", "sim", "subprocess"],
+                    help="SLO-driven autoscaler: 'subprocess' "
+                    "spawns sim replicas as child processes "
+                    "(bench/demo); production wires its own "
+                    "ReplicaController")
+    rt.add_argument("--scaler-min", type=int, default=1)
+    rt.add_argument("--scaler-max", type=int, default=8)
+    rt.add_argument("--scaler-interval", type=float, default=2.0)
+    rt.add_argument("--fault-spec", default="",
+                    help="inject deterministic router faults "
+                    "(replica-flaky response drops; "
+                    "docs/robustness.md)")
+
     plug = sub.add_parser("plugin", help="manage plugins")
     plugsub = plug.add_subparsers(dest="plugin_command")
     pi = plugsub.add_parser("install", help="install from a local "
@@ -565,9 +608,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
-                   "sbom", "k8s", "aws", "db", "server", "watch",
-                   "plugin", "config", "conf", "module", "m",
-                   "client", "c", "version")
+                   "sbom", "k8s", "aws", "db", "server", "route",
+                   "watch", "plugin", "config", "conf", "module",
+                   "m", "client", "c", "version")
 
 
 def main(argv=None) -> int:
@@ -699,6 +742,8 @@ def _dispatch(args) -> int:
         return run_db(args)
     if args.command == "server":
         return run_server(args)
+    if args.command == "route":
+        return run_route(args)
     if args.command == "watch":
         return run_watch(args)
     if args.command == "k8s":
@@ -1021,6 +1066,100 @@ def run_server(args) -> int:
             adm_runner.close()
         if scheduler is not None:
             scheduler.close()
+    return 0
+
+
+def run_route(args) -> int:
+    """``trivy-tpu route``: the fleet front (docs/serving.md "Scan
+    router & autoscaling") — consistent-hash sharding by layer
+    digest across the --replicas set, /healthz probing, breaker
+    ejection, zero-loss failover, optional SLO-driven autoscaling."""
+    from .obs.federate import parse_peers
+    from .router import (Autoscaler, HealthProber, RouterServer,
+                         ScalerPolicy, ScanRouter,
+                         SimReplicaController,
+                         SubprocessReplicaController, serve_router)
+    from .router.scaler import federated_verdicts
+
+    host, _, port = args.listen.rpartition(":")
+    if not port.isdigit():
+        print(f"error: --listen needs host:port, got "
+              f"{args.listen!r}", file=sys.stderr)
+        return 2
+    try:
+        replicas = parse_peers(args.replicas) \
+            if args.replicas else []
+    except ValueError as e:
+        print(f"error: --replicas: {e}", file=sys.stderr)
+        return 2
+    if not replicas and args.scaler == "off":
+        print("error: --replicas is empty and --scaler off: "
+              "nothing to route to", file=sys.stderr)
+        return 2
+    injector = _fault_injector(args)
+    if injector is not None and \
+            not injector.spec.wants_route_faults():
+        print("error: --fault-spec on the route command wants a "
+              "router scenario (replica-flaky / replica-kill)",
+              file=sys.stderr)
+        return 2
+    try:
+        router = ScanRouter(
+            replicas, token=args.auth_token,
+            token_header=args.token_header,
+            vnodes=args.vnodes,
+            capacity_factor=args.capacity_factor,
+            timeout_s=args.upstream_timeout,
+            fault_injector=injector)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    prober = HealthProber(router,
+                          interval_s=args.probe_interval)
+    prober.start()
+    scaler = None
+    if args.scaler != "off":
+        controller = SimReplicaController() \
+            if args.scaler == "sim" \
+            else SubprocessReplicaController()
+        policy = ScalerPolicy(
+            min_replicas=max(0, args.scaler_min),
+            max_replicas=max(1, args.scaler_max),
+            interval_s=args.scaler_interval)
+        scaler = Autoscaler(
+            router, controller, policy=policy,
+            verdict_fn=federated_verdicts(
+                router, token=args.auth_token))
+        # bring the fleet to the floor before serving
+        while len(router.replicas()) < policy.min_replicas:
+            name, url = controller.start()
+            router.add_replica(name, url)
+        scaler.start()
+    front = RouterServer(router, token=args.auth_token,
+                         token_header=args.token_header,
+                         prober=prober, scaler=scaler)
+    httpd, _ = serve_router(front, host or "127.0.0.1", int(port))
+    print(f"trivy-tpu router listening on {args.listen} "
+          f"(fronting {len(router.replicas())} replicas)")
+    import signal
+    import threading
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass                    # not the main thread (tests)
+    try:
+        while not stop.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        front.close()
     return 0
 
 
@@ -1682,8 +1821,8 @@ def _reject_unwired_fault_spec(args) -> bool:
     false confidence, not a passed drill (docs/robustness.md)."""
     if getattr(args, "fault_spec", ""):
         print("error: --fault-spec is wired into multi-target "
-              "image scans and the server; this command would "
-              "inject nothing", file=sys.stderr)
+              "image scans, the server, and the route command; "
+              "this command would inject nothing", file=sys.stderr)
         return True
     return False
 

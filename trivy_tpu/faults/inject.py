@@ -15,7 +15,11 @@ domains:
   poisoned requests, stalls);
 * **rpc** — :meth:`rpc_action` decides per POST whether to answer
   500 before processing or to process and then drop the response
-  (the lost-response case idempotency keys exist for).
+  (the lost-response case idempotency keys exist for);
+* **router** — :meth:`on_route_forward` drops every Nth forwarded
+  response (replica-flaky) and :meth:`replica_kill_due` tells the
+  harness when to kill a backend mid-storm (replica-kill), both
+  drilling the scan router's replay-based failover.
 
 Everything raised here derives from :class:`InjectedFault` so tests
 and logs can tell injected failures from real ones; the cache flavor
@@ -67,7 +71,9 @@ class FaultInjector:
                          "image_loads": 0, "corrupt_faults": 0,
                          "stalls": 0, "rpc_posts": 0,
                          "rpc_errors": 0, "rpc_drops": 0,
-                         "memo_loads": 0, "memo_corruptions": 0}
+                         "memo_loads": 0, "memo_corruptions": 0,
+                         "routed_forwards": 0, "route_drops": 0,
+                         "replica_kills": 0}
 
     def _inc(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -186,6 +192,46 @@ class FaultInjector:
             self._inc("device_faults")
             raise DeviceFault(
                 f"injected transient device error (dispatch #{n})")
+
+    # --- router site (docs/serving.md "Scan router & autoscaling") ---
+
+    def on_route_forward(self, replica: str) -> str:
+        """'ok' | 'drop' — consulted by the router AFTER a forward
+        completed: 'drop' discards the replica's response (the work
+        happened, the client never hears back), forcing the replay-
+        with-same-idempotency-key failover path. ``replica_flaky``
+        scopes the drops to one named replica."""
+        spec = self.spec
+        n = self._inc("routed_forwards")
+        if not spec.replica_flaky_every:
+            return "ok"
+        if spec.replica_flaky and replica != spec.replica_flaky:
+            return "ok"
+        if n % spec.replica_flaky_every == 0:
+            self._inc("route_drops")
+            add_event("fault_injected", site="router",
+                      kind="response-drop", replica=replica)
+            return "drop"
+        return "ok"
+
+    def replica_kill_due(self, forwards: int) -> bool:
+        """replica-kill scenario: True exactly once, the first time
+        the router's forward count reaches the seeded instant — the
+        HARNESS (bench kill arm, tests) then kills the victim
+        replica's process; the spec only carries when."""
+        spec = self.spec
+        if not spec.replica_kill_after:
+            return False
+        if forwards < spec.replica_kill_after:
+            return False
+        with self._lock:
+            if self.counters["replica_kills"]:
+                return False
+            self.counters["replica_kills"] += 1
+        add_event("fault_injected", site="router",
+                  kind="replica-kill",
+                  replica=spec.replica_kill or "(harness pick)")
+        return True
 
     # --- rpc site ---
 

@@ -80,6 +80,21 @@ class FaultSpec:
     storm_digests: int = 0
     storm_malformed: int = 0
 
+    # -- router fleet (docs/serving.md "Scan router & autoscaling"):
+    #    replica_kill_after kills a backend replica mid-storm after
+    #    the router has forwarded N requests (the harness — bench
+    #    kill arm, tests — does the killing; the spec carries the
+    #    seeded instant, and replica_kill optionally names the
+    #    victim, else the harness picks the busiest).
+    #    replica_flaky_every drops every Nth forwarded response at
+    #    the router's fault hook (work done, response lost — the
+    #    replay-with-same-idempotency-key case); replica_flaky
+    #    scopes the drops to one named replica, else any.
+    replica_kill_after: int = 0
+    replica_kill: str = ""
+    replica_flaky_every: int = 0
+    replica_flaky: str = ""
+
     # -- tenant flood (docs/serving.md "Multi-tenant QoS"): like
     #    deadline-storm, the spec only carries the storm's shape —
     #    the harness (bench.py adversarial-tenant arm, tests) runs
@@ -106,6 +121,10 @@ class FaultSpec:
 
     def wants_memo_faults(self) -> bool:
         return bool(self.memo_corrupt_loads)
+
+    def wants_route_faults(self) -> bool:
+        return bool(self.replica_kill_after
+                    or self.replica_flaky_every)
 
     def wants_event_storm(self) -> bool:
         return bool(self.storm_events)
@@ -134,6 +153,8 @@ SCENARIOS: dict = {
     "memo-poison": {"memo_corrupt_loads": 4},
     "tenant-flood": {"flood_tenant": "flooder", "flood_rate": 400.0,
                      "flood_n": 256},
+    "replica-kill": {"replica_kill_after": 32},
+    "replica-flaky": {"replica_flaky_every": 3},
     "event-storm": {"storm_events": 256, "storm_digests": 8,
                     "storm_malformed": 8},
 }

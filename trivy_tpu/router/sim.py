@@ -1,0 +1,341 @@
+"""Simulated scan replica for router tests and bench
+(docs/serving.md "Scan router & autoscaling").
+
+A stdlib-only stand-in for ``trivy-tpu server`` that speaks exactly
+the protocol surface the router depends on — the twirp POST routes,
+``/healthz`` with ``draining``/``inflight``, the 503
+``unavailable``/``resource_exhausted`` split, 429 + Retry-After per
+tenant, and the idempotency-window replay — while modeling the parts
+that matter for fleet behavior:
+
+* bounded concurrency (``max_concurrent`` semaphore): a replica has
+  finite parallelism, so aggregate throughput should scale with the
+  replica count — the bench's ≥ 0.8×N gate is meaningless against an
+  infinitely parallel sleep;
+* per-replica warm state: the set of layer digests this replica has
+  seen; a repeat of a known base digest answers ``memo_hit: true`` —
+  the signal the post-reshard warm-hit bench measures;
+* seeded faults: ``kill_after=N`` hard-exits the process mid-request
+  after N scans (replica death mid-storm), ``flaky_every=N`` does
+  the work then drops every Nth response (the lost-response hazard
+  idempotent replay neutralizes).
+
+IMPORTANT: keep this module importable with stdlib only (no jax, no
+trivy_tpu heavyweight imports) — ``python -m trivy_tpu.router.sim``
+is the subprocess replica the SubprocessReplicaController and the
+bench spawn, and its startup cost is fleet-bringup cost. The twirp
+path constants are restated here (protocol literals, same values as
+``rpc/server.py``) for exactly that reason.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+SCANNER_PREFIX = "/twirp/trivy.scanner.v1.Scanner/"
+CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
+TENANT_HEADER = "Trivy-Tenant"
+IDEM_CAP = 4096
+
+
+class SimReplica:
+    """One simulated replica: in-process (tests) or the target of
+    ``python -m trivy_tpu.router.sim`` (subprocess fleet)."""
+
+    def __init__(self, name: str = "sim", port: int = 0,
+                 addr: str = "127.0.0.1",
+                 service_ms: float = 5.0,
+                 max_concurrent: int = 2,
+                 kill_after: int = 0,
+                 flaky_every: int = 0,
+                 tenant_rate: float = 0.0):
+        self.name = name
+        self.addr = addr
+        self._port = port
+        self.service_ms = max(0.0, service_ms)
+        self.max_concurrent = max(1, max_concurrent)
+        self.kill_after = max(0, kill_after)
+        self.flaky_every = max(0, flaky_every)
+        # tenant_rate > 0: each tenant may start at most this many
+        # scans per second (token bucket, burst == rate)
+        self.tenant_rate = max(0.0, tenant_rate)
+        self._sem = threading.BoundedSemaphore(self.max_concurrent)
+        self._lock = threading.Lock()
+        self._warm: set = set()          # layer digests seen
+        self._blobs: set = set()         # cache-tier blob ids
+        self._idem: OrderedDict = OrderedDict()  # key -> response
+        self._buckets: dict = {}         # tenant -> (tokens, last)
+        self.draining = False
+        self.inflight = 0
+        self.counters = {"scans": 0, "memo_hits": 0, "deduped": 0,
+                         "dropped": 0, "rate_limited": 0,
+                         "cache_ops": 0, "drained_rejects": 0}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd \
+            else self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.addr}:{self.port}"
+
+    def start(self) -> "SimReplica":
+        self._httpd = ThreadingHTTPServer(
+            (self.addr, self._port), _make_handler(self))
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"sim-{self.name}")
+        self._thread.start()
+        return self
+
+    def drain(self) -> None:
+        self.draining = True
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def warm_digests(self) -> set:
+        with self._lock:
+            return set(self._warm)
+
+    # ---- request handlers ----
+
+    def _inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] += n
+
+    def _admit_tenant(self, tenant: str) -> float:
+        """0.0 = admitted; > 0 = retry-after seconds (429)."""
+        if self.tenant_rate <= 0:
+            return 0.0
+        now = time.monotonic()
+        with self._lock:
+            tokens, last = self._buckets.get(
+                tenant, (self.tenant_rate, now))
+            tokens = min(self.tenant_rate,
+                         tokens + (now - last) * self.tenant_rate)
+            if tokens >= 1.0:
+                self._buckets[tenant] = (tokens - 1.0, now)
+                return 0.0
+            self._buckets[tenant] = (tokens, now)
+            return round((1.0 - tokens) / self.tenant_rate, 3)
+
+    def scan(self, body: dict, tenant: str) -> tuple:
+        """(status, payload, drop_response). Models the server's
+        drain gate, idempotency window, memo warmth and service
+        time."""
+        if self.draining:
+            self._inc("drained_rejects")
+            return 503, {"code": "unavailable",
+                         "msg": "sim draining"}, False
+        wait = self._admit_tenant(tenant or "")
+        if wait > 0:
+            self._inc("rate_limited")
+            return 429, {"code": "rate_limited",
+                         "msg": f"tenant {tenant!r} over rate",
+                         "retry_after_s": wait}, False
+        key = str(body.get("idempotency_key") or "")
+        if key:
+            with self._lock:
+                cached = self._idem.get(key)
+            if cached is not None:
+                self._inc("deduped")
+                return 200, dict(cached, deduped=True), False
+        blob_ids = [str(b) for b in body.get("blob_ids") or []]
+        base = blob_ids[0] if blob_ids else ""
+        with self._lock:
+            self.inflight += 1
+            hit = base in self._warm if base else False
+            self._warm.update(b for b in blob_ids if b)
+        try:
+            with self._sem:             # finite parallelism
+                if self.service_ms:
+                    # a memo hit skips the simulated analyze work,
+                    # like the real findings memo does
+                    time.sleep(self.service_ms / 1000.0
+                               * (0.1 if hit else 1.0))
+            with self._lock:
+                self.counters["scans"] += 1
+                n = self.counters["scans"]
+                if hit:
+                    self.counters["memo_hits"] += 1
+            if self.kill_after and n >= self.kill_after:
+                # replica death mid-storm: the response for THIS
+                # request (and every other in-flight one) is never
+                # written — the router must replay them elsewhere
+                os._exit(17)
+            payload = {"os": {"family": "sim", "name": "0"},
+                       "results": [],
+                       "memo_hit": hit,
+                       "replica": self.name}
+            if key:
+                with self._lock:
+                    self._idem[key] = payload
+                    while len(self._idem) > IDEM_CAP:
+                        self._idem.popitem(last=False)
+            drop = bool(self.flaky_every
+                        and n % self.flaky_every == 0)
+            if drop:
+                self._inc("dropped")
+            return 200, payload, drop
+        finally:
+            with self._lock:
+                self.inflight -= 1
+
+    def cache_op(self, path: str, body: dict) -> dict:
+        self._inc("cache_ops")
+        op = path[len(CACHE_PREFIX):]
+        with self._lock:
+            if op == "PutBlob":
+                self._blobs.add(str(body.get("diff_id") or ""))
+            elif op == "DeleteBlobs":
+                for b in body.get("blob_ids") or []:
+                    self._blobs.discard(str(b))
+                    self._warm.discard(str(b))
+            elif op == "MissingBlobs":
+                blob_ids = [str(b)
+                            for b in body.get("blob_ids") or []]
+                return {"missing_artifact": True,
+                        "missing_blob_ids":
+                            [b for b in blob_ids
+                             if b not in self._blobs]}
+        return {}
+
+    def health(self) -> dict:
+        with self._lock:
+            inflight = self.inflight
+        return {"status": "draining" if self.draining else "ok",
+                "draining": self.draining,
+                "inflight": inflight,
+                "build": {"replica": self.name, "sim": True}}
+
+    def metrics(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["warm_digests"] = len(self._warm)
+            out["inflight"] = self.inflight
+        out["draining"] = self.draining
+        out["name"] = self.name
+        return out
+
+
+def _make_handler(sim: SimReplica):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass                    # quiet: bench spawns fleets
+
+        def _reply(self, code: int, payload: dict,
+                   headers=None) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in headers or ():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, sim.health())
+            elif self.path == "/metrics":
+                self._reply(200, sim.metrics())
+            else:
+                self._reply(404, {"code": "bad_route",
+                                  "msg": self.path})
+
+        def do_POST(self):
+            if self.path == "/drain":
+                sim.drain()
+                self._reply(200, {"draining": True})
+                return
+            try:
+                length = int(self.headers.get("Content-Length")
+                             or 0)
+                body = json.loads(self.rfile.read(length)
+                                  or b"{}")
+            except ValueError:
+                self._reply(400, {"code": "malformed",
+                                  "msg": "invalid json body"})
+                return
+            if not isinstance(body, dict):
+                body = {}
+            if self.path == SCANNER_PREFIX + "Scan":
+                tenant = str(body.get("tenant")
+                             or self.headers.get(TENANT_HEADER)
+                             or "")
+                code, payload, drop = sim.scan(body, tenant)
+                if drop:
+                    # lost response: work done, client unanswered
+                    self.close_connection = True
+                    return
+                headers = []
+                if code == 429:
+                    import math
+                    headers = [("Retry-After", str(int(math.ceil(
+                        payload.get("retry_after_s", 1.0)))))]
+                self._reply(code, payload, headers)
+            elif self.path.startswith(CACHE_PREFIX):
+                if sim.draining:
+                    self._reply(503, {"code": "unavailable",
+                                      "msg": "sim draining"})
+                    return
+                self._reply(200, sim.cache_op(self.path, body))
+            else:
+                self._reply(404, {"code": "bad_route",
+                                  "msg": self.path})
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    """Subprocess entry: start one replica and serve until killed.
+    Prints ``PORT <n>`` on stdout so the spawning controller learns
+    the bound port when asked for port 0."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(prog="trivy-tpu-sim-replica")
+    p.add_argument("--name", default="sim")
+    p.add_argument("--addr", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--service-ms", type=float, default=5.0)
+    p.add_argument("--max-concurrent", type=int, default=2)
+    p.add_argument("--kill-after", type=int, default=0)
+    p.add_argument("--flaky-every", type=int, default=0)
+    p.add_argument("--tenant-rate", type=float, default=0.0)
+    args = p.parse_args(argv)
+    sim = SimReplica(name=args.name, port=args.port,
+                     addr=args.addr, service_ms=args.service_ms,
+                     max_concurrent=args.max_concurrent,
+                     kill_after=args.kill_after,
+                     flaky_every=args.flaky_every,
+                     tenant_rate=args.tenant_rate).start()
+    print(f"PORT {sim.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    sim.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -65,6 +65,11 @@ class _Client:
         # trace_id of the most recent Scan call (RemoteScanner):
         # lets a CLI client surface "see /trace/<id> on the server"
         self.last_trace_id = ""
+        # replica that served the most recent call, when a scan
+        # router fronted it (Trivy-Routed-Replica header / the Scan
+        # body's routed_replica field); "" when talking to a single
+        # server directly
+        self.last_routed_replica = ""
         # retry accounting: total retry sleeps taken, and how many
         # of them were server 429 rate-limit shed (docs/serving.md
         # "Multi-tenant QoS") vs transient 5xx/connection failures
@@ -107,6 +112,8 @@ class _Client:
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as resp:
+                    self.last_routed_replica = resp.headers.get(
+                        "Trivy-Routed-Replica") or ""
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as e:
                 detail = e.read().decode("utf-8", "replace")
@@ -130,6 +137,25 @@ class _Client:
                     last_err = RPCError(e.code, detail)
                     log.debug("rate-limited on %s (retry-after=%s)",
                               path, retry_after)
+                elif e.code == 503:
+                    # transient by contract (drain/unavailable or
+                    # queue-full shed) — and when a router or a
+                    # draining server sent a Retry-After, honor it
+                    # exactly like a 429's: header as the fallback,
+                    # the JSON body's retry_after_s (sub-second
+                    # precision) preferred
+                    retry_after = (e.headers.get("Retry-After")
+                                   if e.headers else "") or ""
+                    try:
+                        body_hint = json.loads(detail).get(
+                            "retry_after_s")
+                        if body_hint is not None:
+                            retry_after = str(float(body_hint))
+                    except (ValueError, AttributeError):
+                        pass
+                    last_err = RPCError(e.code, detail)
+                    log.debug("retrying %s after 503 "
+                              "(retry-after=%s)", path, retry_after)
                 elif e.code >= 500:         # transient: retry
                     last_err = RPCError(e.code, detail)
                     log.debug("retrying %s after %d: %s",
@@ -251,6 +277,15 @@ class RemoteScanner(_Client):
         # where the answer would arrive too late to matter
         out = self.call(SCANNER_PREFIX + "Scan", body,
                         deadline_s=deadline_s)
+        # behind a scan router the response says which backend
+        # replica served it (body field; the header is the fallback
+        # call() already captured) — callers log it for debugging
+        # ring placement
+        routed = str(out.get("routed_replica") or "")
+        if routed:
+            self.last_routed_replica = routed
+            log.debug("scan %r served by replica %s", target.name,
+                      routed)
         results = [result_from_dict(r)
                    for r in out.get("results") or []]
         return results, os_from_dict(out.get("os"))

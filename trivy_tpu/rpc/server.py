@@ -222,6 +222,12 @@ class ScanServer:
         self.token_header = token_header
         self._idem = _IdempotencyCache()
         self._draining = False
+        # Scan RPCs currently being served; mirrored into /healthz
+        # (with the draining flag) so a scan router can stop routing
+        # NEW work here before the 503s start, and can tell when a
+        # draining replica has quiesced
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         # fault_injector: trivy_tpu.faults.FaultInjector (or None);
         # the HTTP handler consults it per POST (--fault-spec)
         self.fault_injector = None
@@ -302,6 +308,21 @@ class ScanServer:
             backend=backend,
             sched="on" if self.scheduler is not None else "off")
 
+    def health(self) -> dict:
+        """The ``GET /healthz`` payload. ``draining`` flips the
+        moment :meth:`begin_drain` runs — while the listener is
+        still up delivering in-flight responses — so a router
+        watching this field stops sending NEW work before it ever
+        sees a drain 503. ``inflight`` counts Scan RPCs currently
+        being served (a drained replica is safe to stop when it
+        reaches zero)."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        return {"status": "draining" if self._draining else "ok",
+                "draining": self._draining,
+                "inflight": inflight,
+                "build": self.build_info()}
+
     def close(self) -> None:
         # only tear down a scheduler this server constructed — an
         # externally provided one may serve other request sources
@@ -355,6 +376,15 @@ class ScanServer:
         on (or replays) the first enqueue's outcome instead."""
         if self._draining:
             raise ServerDraining("server draining, retry elsewhere")
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            return self._scan_idempotent(body)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _scan_idempotent(self, body: dict) -> dict:
         tenant = _clean_tenant(body.get("tenant"))
         key = str(body.get("idempotency_key") or "")[:128]
         if not key:
@@ -722,8 +752,7 @@ def _make_handler(server: ScanServer):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {"status": "ok",
-                                  "build": server.build_info()})
+                self._reply(200, server.health())
             elif self.path == "/metrics/snapshot":
                 # the federation pull: replica identity + prom text
                 # + age-keyed SLO bucket export, token-protected like
