@@ -2727,6 +2727,250 @@ def bench_router() -> dict:
     return out
 
 
+def bench_impact() -> dict:
+    """``--config impact`` (docs/serving.md "CVE impact queries &
+    push re-scans"): the inverted (package, CVE) → layers → images
+    index over a 512-image warm fleet behind routed replicas. Gated
+    arms:
+
+    * **overhead** — write-through index maintenance < 2% of the
+      warm-fleet scan wall, and the incremental index snapshots
+      byte-identically to a brute-force inversion of the memo tier;
+    * **exactness** — a db-update hot swap's push stream emits
+      EXACTLY the image set whose findings a brute-force cold
+      re-scan diff says the advisory delta changed;
+    * **query** — ``GET /impact?cve=`` through a real router front
+      over sharded replica slices answers with single-digit-ms p99,
+      and the federated union equals the unsharded answer;
+    * **reshard** — kill one replica: the survivors' re-armed ring
+      slices (no index surgery) and a successor rebuilt from the
+      shared memo tier both answer byte-identically to a fresh
+      brute-force inversion of the same slice.
+    """
+    import math
+    import os
+    import tempfile
+    import urllib.request
+
+    from trivy_tpu.artifact.cache import MemoryCache
+    from trivy_tpu.db.compiled import SwappableStore
+    from trivy_tpu.db.lifecycle import attach_memo
+    from trivy_tpu.impact import (IMPACT_METRICS,
+                                  IMPACT_RESCAN_PRIORITY,
+                                  ImpactIndex, ImpactPusher,
+                                  brute_force_invert)
+    from trivy_tpu.memo import FindingsMemo, MemoryMemoStore
+    from trivy_tpu.router.core import ScanRouter
+    from trivy_tpu.router.front import RouterServer, serve_router
+    from trivy_tpu.router.ring import Ring
+    from trivy_tpu.rpc.server import ScanServer, serve
+    from trivy_tpu.runtime import BatchScanRunner
+    from trivy_tpu.watch.source import WebhookSource
+
+    n_images = int(os.environ.get("WARM_FLEET_IMAGES", N_IMAGES))
+    out: dict = {"images": n_images}
+
+    def report_pairs(results) -> dict:
+        pairs: dict = {}
+        for r in results:
+            assert not r.error, r.error
+            found = set()
+            for res in (r.report.to_dict().get("Results") or ()):
+                for v in (res.get("Vulnerabilities") or ()):
+                    found.add((v.get("PkgName", ""),
+                               v.get("VulnerabilityID", "")))
+            pairs[r.name] = found
+        return pairs
+
+    def canon(snapshot: dict) -> str:
+        return json.dumps(snapshot, sort_keys=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cold_paths, warm_paths = make_warm_fleet(tmp, n_images)
+        cdb1, cdb2 = _warm_stores()
+
+        # XLA warm-up at fleet shape (same rationale as bench_images)
+        BatchScanRunner(store=cdb1,
+                        backend="tpu").scan_paths(cold_paths)
+
+        IMPACT_METRICS.reset()
+        shared = MemoryMemoStore()
+        memo = FindingsMemo(shared, backend="tpu")
+        push_src = WebhookSource()
+        idx = ImpactIndex(store=memo.store, name="ingest",
+                          pusher=ImpactPusher(push_src))
+        memo.attach_impact(idx)
+        cache = MemoryCache()
+        runner = BatchScanRunner(store=cdb1, cache=cache,
+                                 backend="tpu", memo=memo)
+        # pass 1 populates memo + index write-through (stores);
+        # pass 2 is the steady warm state the overhead gate measures
+        runner.scan_paths(warm_paths)
+
+        # ---- arm 1: maintenance overhead + incremental identity ----
+        m0 = IMPACT_METRICS.snapshot()
+        t0 = time.perf_counter()
+        runner.scan_paths(warm_paths)
+        warm_s = time.perf_counter() - t0
+        m1 = IMPACT_METRICS.snapshot()
+        maint_s = m1["maintenance_s"] - m0["maintenance_s"]
+        share = maint_s / max(1e-9, warm_s)
+        out["warm_s"] = round(warm_s, 2)
+        out["warm_images_per_sec"] = round(n_images / warm_s, 2)
+        out["maintenance_s"] = round(maint_s, 4)
+        out["maintenance_share"] = round(share, 5)
+        assert share < 0.02, \
+            f"index maintenance {share:.2%} >= 2% of warm wall"
+
+        snap1 = idx.postings_snapshot()
+        assert canon(snap1) == canon(brute_force_invert(memo, cdb1)), \
+            "incremental index diverges from brute-force inversion"
+        assert snap1["postings"], "index indexed nothing"
+        out["postings"] = len(snap1["postings"])
+        out["indexed_images"] = len(snap1["images"])
+
+        # brute-force ground truth at gen1: a cold no-memo scan
+        pre_pairs = report_pairs(BatchScanRunner(
+            store=cdb1, backend="tpu").scan_paths(warm_paths))
+
+        # ---- arm 2: hot swap -> push-stream exactness ----
+        sw = SwappableStore(cdb1)
+        attach_memo(sw, memo)
+        t0 = time.perf_counter()
+        sw.swap(cdb2, stage=False)
+        swap_s = time.perf_counter() - t0
+        pushed = set()
+        while True:
+            ev = push_src.get(timeout=0.0)
+            if ev is None:
+                break
+            assert ev.priority == IMPACT_RESCAN_PRIORITY, ev
+            pushed.add(ev.path)
+        # post-swap index == a fresh inversion of the migrated tier
+        assert canon(idx.postings_snapshot()) == \
+            canon(brute_force_invert(memo, cdb2)), \
+            "hot-swap-migrated index diverges from fresh inversion"
+        post_pairs = report_pairs(BatchScanRunner(
+            store=cdb2, backend="tpu").scan_paths(warm_paths))
+        affected_truth = {
+            name for name, pairs in post_pairs.items()
+            if pairs - pre_pairs[name]}
+        assert pushed == affected_truth, \
+            (f"push stream emitted {len(pushed)} images, brute-force "
+             f"re-scan diff says {len(affected_truth)}; "
+             f"spurious={sorted(pushed - affected_truth)[:3]} "
+             f"missed={sorted(affected_truth - pushed)[:3]}")
+        assert pushed, "advisory delta affected no images"
+        out["swap_s"] = round(swap_s, 4)
+        out["push_affected_images"] = len(pushed)
+        out["push_set_exact"] = True
+
+        # ---- arm 3: GET /impact?cve= p99 through the router ----
+        n_shards = 3
+        names = [f"i{k}" for k in range(n_shards)]
+        ring = Ring()
+        for nm in names:
+            ring.add(nm)
+
+        def owns_for(nm):
+            return lambda blob, _n=nm: \
+                (ring.walk(blob) or [None])[0] == _n
+
+        shard_idx = []
+        for nm in names:
+            ix = ImpactIndex(store=memo.store, owns=owns_for(nm),
+                             name=nm)
+            reb = ix.rebuild(memo, cdb2)
+            assert reb["complete"], reb
+            shard_idx.append(ix)
+
+        cve = "CVE-2024-77777"
+        ref = idx.query(cve)
+        assert ref["images"], f"{cve} affects no indexed image"
+        servers = []
+        front = None
+        httpd_r = None
+        try:
+            replicas = []
+            for nm, ix in zip(names, shard_idx):
+                srv = ScanServer(token="bench", impact=ix)
+                httpd, _ = serve(port=0, server=srv)
+                servers.append((srv, httpd))
+                replicas.append(
+                    (nm,
+                     f"http://127.0.0.1:{httpd.server_address[1]}"))
+            router = ScanRouter(replicas, token="bench")
+            front = RouterServer(router, token="bench")
+            httpd_r, _ = serve_router(front, port=0)
+            base = f"http://127.0.0.1:{httpd_r.server_address[1]}"
+            lat = []
+            doc = None
+            for _ in range(120):
+                req = urllib.request.Request(
+                    f"{base}/impact?cve={cve}")
+                req.add_header("Trivy-Token", "bench")
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req,
+                                            timeout=5.0) as resp:
+                    doc = json.loads(resp.read().decode("utf-8"))
+                lat.append(time.perf_counter() - t0)
+            assert doc["complete"] is True, doc["replicas"]
+            # federated union over ring slices == unsharded answer
+            for k in ("packages", "layers", "images"):
+                assert doc[k] == ref[k], \
+                    f"federated {k} diverge from unsharded index"
+            lat.sort()
+            p99 = lat[min(len(lat) - 1,
+                          int(math.ceil(0.99 * len(lat))) - 1)]
+            gate = float(os.environ.get("IMPACT_P99_GATE", "0.010"))
+            out["query_p50_ms"] = round(
+                lat[len(lat) // 2] * 1000, 3)
+            out["query_p99_ms"] = round(p99 * 1000, 3)
+            assert p99 < gate, \
+                (f"GET /impact p99 {p99 * 1000:.1f}ms >= "
+                 f"{gate * 1000:.0f}ms through the router")
+            out["federated_exact"] = True
+        finally:
+            if httpd_r is not None:
+                httpd_r.shutdown()
+            if front is not None:
+                front.close()
+            for srv, httpd in servers:
+                httpd.shutdown()
+                srv.close()
+
+        # ---- arm 4: kill one replica, reshard, verify exact ----
+        ring.remove(names[0])
+        union_layers: set = set()
+        union_images: dict = {}
+        for nm, ix in list(zip(names, shard_idx))[1:]:
+            ix.set_owner(owns_for(nm))     # re-arm, no surgery
+            fresh = brute_force_invert(memo, cdb2,
+                                       owns=owns_for(nm))
+            assert canon(ix.postings_snapshot()) == canon(fresh), \
+                f"survivor {nm}'s re-armed slice diverges from a " \
+                f"fresh rebuild"
+            a = ix.query(cve)
+            union_layers.update(a["layers"])
+            union_images.update(dict(a["images"]))
+        # a cold successor recovers the same slice from the tier
+        successor = ImpactIndex(store=memo.store,
+                                owns=owns_for(names[1]),
+                                name="successor")
+        reb = successor.rebuild(memo, cdb2)
+        assert reb["complete"], reb
+        assert canon(successor.postings_snapshot()) == \
+            canon(shard_idx[1].postings_snapshot()), \
+            "successor rebuild diverges from the live survivor"
+        # the survivors' slices still cover the whole answer
+        assert sorted(union_layers) == ref["layers"]
+        assert sorted([i, t] for i, t in union_images.items()) \
+            == ref["images"]
+        out["reshard_exact"] = True
+        IMPACT_METRICS.reset()
+    return out
+
+
 def _run_config(cfg: str) -> dict:
     return {"images": bench_images, "sboms": bench_sboms,
             "mesh": bench_mesh_scaling,
@@ -2739,7 +2983,8 @@ def _run_config(cfg: str) -> dict:
             "fleet-obs": bench_fleet_obs,
             "watch": bench_watch,
             "witness": bench_witness,
-            "router": bench_router}[cfg]()
+            "router": bench_router,
+            "impact": bench_impact}[cfg]()
 
 
 def _subprocess_config(cfg: str) -> dict:
@@ -2793,6 +3038,7 @@ def main() -> None:
     watch = _subprocess_config("watch")
     witness = _subprocess_config("witness")
     router = _subprocess_config("router")
+    impact = _subprocess_config("impact")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -2825,6 +3071,7 @@ def main() -> None:
         "watch": watch,
         "witness": witness,
         "router": router,
+        "impact": impact,
     }))
 
 

@@ -1,0 +1,622 @@
+"""Fleet-wide inverted findings index tests (``pytest -m impact``,
+docs/serving.md "CVE impact queries & push re-scans").
+
+The contract under test: the incremental (package, CVE) → layers →
+images index — maintained as a write-through side effect of memo
+stores, corrupt drops, and hot-swap migrations — snapshots
+byte-identically to a brute-force inversion of the shared memo tier
+after ANY seeded sequence of scans, db hot swaps, and evictions;
+replica ring slices union to the exact fleet answer and survive a
+kill-one-replica reshard; federated ``/impact`` queries answer
+partially (``complete: false``) when a peer is down, never with an
+error; and the hot-swap push stream folds into the watch loop's
+debounce like any other event burst.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trivy_tpu.db import AdvisoryStore, CompiledDB
+from trivy_tpu.db.compiled import SwappableStore
+from trivy_tpu.db.lifecycle import attach_memo
+from trivy_tpu.impact import (IMPACT_KEY_PREFIX, IMPACT_METRICS,
+                              IMPACT_RESCAN_PRIORITY, ImpactIndex,
+                              ImpactPusher, brute_force_invert,
+                              entry_postings, federated_impact,
+                              image_key, is_impact_key)
+from trivy_tpu.impact.index import (decode_image_record,
+                                    encode_image_record)
+from trivy_tpu.memo import FindingsMemo, MemoryMemoStore
+from trivy_tpu.memo.store import (FSMemoStore, ResilientMemoStore)
+from trivy_tpu.router.ring import Ring
+from trivy_tpu.runtime import BatchScanRunner
+from trivy_tpu.utils.synth import write_image_tar
+from trivy_tpu.watch import (WATCH_METRICS, WatchConfig, WatchLoop,
+                             WebhookSource)
+
+pytestmark = pytest.mark.impact
+
+N_PKGS = 10
+
+
+def _canon(snapshot: dict) -> str:
+    return json.dumps(snapshot, sort_keys=True)
+
+
+def _random_store(rng) -> AdvisoryStore:
+    store = AdvisoryStore()
+    for i in range(N_PKGS):
+        for a in range(1 + int(rng.integers(0, 3))):
+            vid = f"CVE-2024-{1000 * i + a}"
+            store.put_advisory(
+                "alpine 3.16", f"pkg{i}", vid,
+                {"FixedVersion":
+                 f"1.{int(rng.integers(0, 9))}."
+                 f"{int(rng.integers(0, 9))}-r0"})
+            store.put_vulnerability(vid, {"Severity": "HIGH",
+                                          "Title": f"adv {vid}"})
+    return store
+
+
+def _mutate(rng, old: AdvisoryStore) -> AdvisoryStore:
+    """Change some fixed versions, add one new advisory — a
+    realistic ``db update`` delta."""
+    new = AdvisoryStore()
+    for bucket, pkgs in old.buckets.items():
+        for pkg, advs in pkgs.items():
+            for vid, val in advs.items():
+                val = dict(val)
+                if rng.random() < 0.3:
+                    val["FixedVersion"] = \
+                        f"2.{int(rng.integers(0, 9))}.9-r0"
+                new.put_advisory(bucket, pkg, vid, val)
+    for vid, v in old.vulnerabilities.items():
+        new.put_vulnerability(vid, v)
+    add_to = f"pkg{int(rng.integers(0, N_PKGS))}"
+    vid = f"CVE-2025-{int(rng.integers(10000, 99999))}"
+    new.put_advisory("alpine 3.16", add_to, vid,
+                     {"FixedVersion": "1.0.1-r0"})
+    new.put_vulnerability(vid, {"Severity": "CRITICAL",
+                                "Title": "hot-swap delta"})
+    return new
+
+
+APK = """P:{name}
+V:{version}
+o:{name}
+L:MIT
+
+"""
+
+
+def _fleet(tmp_path, n_images: int = 3) -> list:
+    """Small fleet sharing one apk layer (the memoized, indexed one)
+    plus a unique text layer per image."""
+    apk = "".join(APK.format(name=f"pkg{i}",
+                             version=f"1.{i % 7}.{i % 5}-r0")
+                  for i in range(N_PKGS))
+    shared = {"etc/alpine-release": b"3.16.2\n",
+              "lib/apk/db/installed": apk.encode()}
+    paths = []
+    for n in range(n_images):
+        p = str(tmp_path / f"img{n}.tar")
+        write_image_tar(p, [shared,
+                            {f"srv/a{n}.txt": b"x = %d\n" % n}],
+                        repo_tag=f"impact/img:{n}")
+        paths.append(p)
+    return paths
+
+
+def _scan(paths, cdb, memo):
+    runner = BatchScanRunner(store=cdb, backend="cpu-ref",
+                             memo=memo)
+    results = runner.scan_paths(paths)
+    assert all(not r.error for r in results), \
+        [r.error for r in results]
+    return results
+
+
+# ------------------------------------------------------------------
+# the property: incremental == brute-force, whatever happened
+# ------------------------------------------------------------------
+
+class TestIncrementalIdentity:
+    @pytest.mark.parametrize("seed", [11, 23])
+    def test_scan_swap_evict_sequence(self, tmp_path, seed):
+        """Seeded random scan / hot-swap / evict sequences: after
+        every step the incremental index snapshots byte-identically
+        to a brute-force inversion of the memo tier."""
+        rng = np.random.default_rng(seed)
+        paths = _fleet(tmp_path, 3)
+        adv = _random_store(rng)
+        cdb = CompiledDB.compile(adv)
+        memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+        idx = ImpactIndex(store=memo.store)
+        memo.attach_impact(idx)
+
+        def check():
+            assert _canon(idx.postings_snapshot()) == \
+                _canon(brute_force_invert(memo, cdb))
+
+        _scan(paths, cdb, memo)
+        check()
+        assert idx.postings_snapshot()["postings"], \
+            "fleet with vulnerable packages indexed nothing"
+
+        for _step in range(4):
+            op = int(rng.integers(0, 3))
+            if op == 0:                 # re-scan a random subset
+                k = 1 + int(rng.integers(0, len(paths)))
+                _scan(list(rng.choice(paths, size=k,
+                                      replace=False)), cdb, memo)
+            elif op == 1:               # db hot swap + delta rematch
+                adv = _mutate(rng, adv)
+                new_cdb = CompiledDB.compile(adv)
+                sw = SwappableStore(cdb)
+                attach_memo(sw, memo)
+                sw.swap(new_cdb, stage=False)
+                cdb = new_cdb
+            else:                       # evict: corrupt one entry
+                keys = [k for k in memo.store.keys()
+                        if not is_impact_key(k)]
+                if keys:
+                    victim = keys[int(rng.integers(0, len(keys)))]
+                    memo.store.put(victim, b"torn-write")
+                    _scan(paths, cdb, memo)   # drop + recompute
+            check()
+
+    def test_set_entry_diff_reports_only_new_pairs(self):
+        idx = ImpactIndex()
+        added = idx.set_entry("k1", "b1", [("p", "CVE-1")])
+        assert added == (("p", "CVE-1"),)
+        # unchanged postings: nothing newly affected
+        assert idx.set_entry("k1", "b1", [("p", "CVE-1")]) == ()
+        # a second entry for the same blob holding the same pair:
+        # refcount 1 -> 2, still not "new"
+        assert idx.set_entry("k2", "b1", [("p", "CVE-1")]) == ()
+        # swap-shaped update: one pair stays, one arrives
+        added = idx.set_entry("k1", "b1",
+                              [("p", "CVE-1"), ("p", "CVE-2")])
+        assert added == (("p", "CVE-2"),)
+        # dropping one holder keeps the pair; dropping both ends it
+        idx.drop_entry("k1")
+        assert idx.query("CVE-1")["layers"] == ["b1"]
+        idx.drop_entry("k2")
+        assert idx.query("CVE-1")["layers"] == []
+
+    def test_rename_carries_postings_without_rederivation(self):
+        idx = ImpactIndex()
+        idx.set_entry("old", "b1", [("p", "CVE-1")])
+        idx.rename_entry("old", "new")
+        assert _canon(idx.postings_snapshot()) == _canon(
+            {"postings": [["p", "CVE-1", ["b1"]]], "images": []})
+        idx.drop_entry("old")           # no-op after the rename
+        assert idx.query("CVE-1")["layers"] == ["b1"]
+
+    def test_non_compiled_store_yields_no_postings(self):
+        assert entry_postings({"subs": {"q": {"hits": [0]}}},
+                              AdvisoryStore()) == ()
+
+
+# ------------------------------------------------------------------
+# sharding: ring slices, reshard, successor rebuild
+# ------------------------------------------------------------------
+
+class TestReshard:
+    def test_kill_one_replica_rebuild_exact(self, tmp_path):
+        """3 ring slices over one memo tier; kill one replica: the
+        survivors' re-armed slices and a cold successor rebuilt from
+        the tier all answer byte-identically to a fresh brute-force
+        inversion, and their union still covers the fleet answer."""
+        rng = np.random.default_rng(7)
+        paths = _fleet(tmp_path, 4)
+        cdb = CompiledDB.compile(_random_store(rng))
+        memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+        ingest = ImpactIndex(store=memo.store)
+        memo.attach_impact(ingest)
+        _scan(paths, cdb, memo)
+        full = ingest.postings_snapshot()
+        assert full["postings"]
+
+        names = ["r0", "r1", "r2"]
+        ring = Ring()
+        for nm in names:
+            ring.add(nm)
+
+        def owns_for(nm):
+            return lambda blob, _n=nm: \
+                (ring.walk(blob) or [None])[0] == _n
+
+        shards = []
+        for nm in names:
+            ix = ImpactIndex(store=memo.store, owns=owns_for(nm),
+                             name=nm)
+            assert ix.rebuild(memo, cdb)["complete"]
+            shards.append(ix)
+
+        ring.remove("r0")               # the kill: slices move
+        merged: dict = {}
+        for nm, ix in list(zip(names, shards))[1:]:
+            ix.set_owner(owns_for(nm))  # re-arm only, no surgery
+            fresh = brute_force_invert(memo, cdb,
+                                       owns=owns_for(nm))
+            assert _canon(ix.postings_snapshot()) == _canon(fresh)
+            for pkg, cve, blobs in \
+                    ix.postings_snapshot()["postings"]:
+                merged.setdefault((pkg, cve), set()).update(blobs)
+        # survivors' slices still partition the full digest space
+        assert sorted((p, c, sorted(bs))
+                      for (p, c), bs in merged.items()) == \
+            sorted((p, c, bs) for p, c, bs in full["postings"])
+
+        # a cold successor recovers the same slice from the tier
+        successor = ImpactIndex(store=memo.store,
+                                owns=owns_for("r1"))
+        assert successor.rebuild(memo, cdb)["complete"]
+        assert _canon(successor.postings_snapshot()) == \
+            _canon(shards[1].postings_snapshot())
+
+    def test_degraded_scan_flags_partial(self, tmp_path):
+        """A tier whose key scan fails mid-walk rebuilds a PARTIAL
+        index flagged complete=False — Federator semantics, not an
+        error."""
+        rng = np.random.default_rng(3)
+        paths = _fleet(tmp_path, 2)
+        cdb = CompiledDB.compile(_random_store(rng))
+        memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+        memo.attach_impact(ImpactIndex(store=memo.store))
+        _scan(paths, cdb, memo)
+
+        class Outage:
+            def scan_keys(self, prefix="", limit=0):
+                raise ConnectionError("tier down")
+
+            def get(self, key):
+                raise ConnectionError("tier down")
+
+        degraded = FindingsMemo(MemoryMemoStore(),
+                                backend="cpu-ref")
+        degraded.store = ResilientMemoStore(Outage())
+        idx = ImpactIndex()
+        out = idx.rebuild(degraded, cdb)
+        assert out["complete"] is False and out["entries"] == 0
+        q = idx.query("CVE-2024-1000")
+        assert q["complete"] is False and q["layers"] == []
+
+
+# ------------------------------------------------------------------
+# scan_keys across memo backends
+# ------------------------------------------------------------------
+
+class TestScanKeys:
+    def test_memory_prefix_and_limit(self):
+        m = MemoryMemoStore()
+        for k in ("aaa1", "aab2", "bbb3"):
+            m.put(k, b"x")
+        assert m.scan_keys("") == (["aaa1", "aab2", "bbb3"], True)
+        assert m.scan_keys("aa") == (["aaa1", "aab2"], True)
+        keys, complete = m.scan_keys("", limit=2)
+        assert keys == ["aaa1", "aab2"] and complete is False
+
+    def test_fs_prefix_and_raise_on_unreadable(self, tmp_path):
+        fs = FSMemoStore(str(tmp_path))
+        fs.put("deadbeef01", b"x")
+        fs.put("deadbeef02", b"y")
+        fs.put("cafe03", b"z")
+        assert fs.scan_keys("dead") == \
+            (["deadbeef01", "deadbeef02"], True)
+        # unlike keys(), scan_keys RAISES on an unreadable dir so
+        # the resilient wrapper can flag the iteration incomplete
+        import shutil
+        shutil.rmtree(fs.dir)
+        with open(fs.dir, "w", encoding="utf-8") as f:
+            f.write("not a dir")
+        with pytest.raises(OSError):
+            fs.scan_keys("")
+
+    def test_resilient_outage_partial_never_error(self):
+        class Down:
+            def scan_keys(self, prefix="", limit=0):
+                raise ConnectionError("backend down")
+
+        r = ResilientMemoStore(Down())
+        assert r.scan_keys("") == ([], False)
+
+    def test_resilient_fallback_without_scan_keys(self):
+        class Legacy:
+            def keys(self):
+                return ["b", "a", "ab"]
+
+        r = ResilientMemoStore(Legacy())
+        assert r.scan_keys("a") == (["a", "ab"], True)
+        assert r.scan_keys("a", limit=1) == (["a"], False)
+
+
+# ------------------------------------------------------------------
+# persisted image records + hot-swap coexistence
+# ------------------------------------------------------------------
+
+class TestImageRecords:
+    def test_roundtrip_and_corruption(self):
+        raw = encode_image_record("img:1", "acme",
+                                  ["sha256:b", "sha256:a"])
+        rec = decode_image_record(raw)
+        assert rec["image"] == "img:1" and rec["tenant"] == "acme"
+        assert rec["blobs"] == ["sha256:a", "sha256:b"]
+        assert decode_image_record(raw[:-4] + b'xx}') is None
+        assert decode_image_record(b"\xff\xfe") is None
+        assert image_key("img:1").startswith(IMPACT_KEY_PREFIX)
+
+    def test_hot_swap_leaves_impact_records_intact(self, tmp_path):
+        """The memo's hot-swap key walk must SKIP impact records —
+        they fail the memo checksum and would be deleted as corrupt
+        otherwise."""
+        rng = np.random.default_rng(13)
+        paths = _fleet(tmp_path, 2)
+        adv = _random_store(rng)
+        cdb = CompiledDB.compile(adv)
+        memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+        idx = ImpactIndex(store=memo.store)
+        memo.attach_impact(idx)
+        _scan(paths, cdb, memo)
+        rec_keys = [k for k in memo.store.keys()
+                    if is_impact_key(k)]
+        assert rec_keys, "scans must persist image records"
+        corrupt_before = memo.stats()["corrupt"]
+        sw = SwappableStore(cdb)
+        attach_memo(sw, memo)
+        sw.swap(CompiledDB.compile(_mutate(rng, adv)),
+                stage=False)
+        assert memo.stats()["corrupt"] == corrupt_before
+        for k in rec_keys:
+            assert decode_image_record(memo.store.get(k)) \
+                is not None
+
+    def test_unchanged_record_skips_the_store_put(self):
+        IMPACT_METRICS.reset()
+        idx = ImpactIndex(store=MemoryMemoStore())
+        idx.observe_image("img", ["b1"], tenant="t")
+        idx.observe_image("img", ["b1"], tenant="t")
+        snap = IMPACT_METRICS.snapshot()
+        assert snap["persist_puts"] == 1
+        assert snap["persist_skips"] == 1
+        IMPACT_METRICS.reset()
+
+
+# ------------------------------------------------------------------
+# the push stream: priority, tenant scope, debounce fold
+# ------------------------------------------------------------------
+
+class TestPushStream:
+    def test_events_carry_priority_tenant_and_digest(self):
+        src = WebhookSource()
+        before = WATCH_METRICS.snapshot().get("impact_rescans", 0)
+        pusher = ImpactPusher(src)
+        n = pusher.push([("/img/a.tar", "acme"),
+                         ("/img/b.tar", "")])
+        assert n == 2
+        assert WATCH_METRICS.snapshot()["impact_rescans"] == \
+            before + 2
+        ev = src.get(timeout=0.0)
+        assert ev.priority == IMPACT_RESCAN_PRIORITY > 0
+        assert ev.tenant == "acme"
+        assert ev.path == "/img/a.tar"
+        # same digest formula as SyntheticSource: repushes of the
+        # same path fold into the loop's per-digest debounce
+        assert ev.digest == "sha256:" + hashlib.sha256(
+            b"/img/a.tar").hexdigest()
+
+    def test_push_storm_folds_into_debounce(self, tmp_path):
+        from trivy_tpu.utils.synth import tiny_fleet
+        paths, store = tiny_fleet(str(tmp_path), 2)
+        src = WebhookSource()
+        ImpactPusher(src).push(
+            [(paths[0], ""), (paths[0], ""), (paths[0], ""),
+             (paths[1], "")])
+        src.close()
+        runner = BatchScanRunner(store=store, backend="cpu-ref")
+        loop = WatchLoop(runner, src, WatchConfig(debounce_s=0.05))
+        stats = loop.run()
+        runner.close()
+        # 4 events, 2 distinct digests: the repushed image scans
+        # once, the burst folds away
+        assert stats["scans"] == 2
+        assert stats["deduped"] == 2
+        assert stats["events"] == stats["scans"] + \
+            stats["deduped"] + stats["shed"]
+
+    def test_hot_swap_emits_only_newly_affected(self, tmp_path):
+        """The push set is the delta's NEW (pkg, CVE) pairs only —
+        re-stored-but-unchanged entries push nothing."""
+        rng = np.random.default_rng(29)
+        paths = _fleet(tmp_path, 3)
+        adv1 = _random_store(rng)
+        cdb1 = CompiledDB.compile(adv1)
+        src = WebhookSource()
+        memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+        idx = ImpactIndex(store=memo.store,
+                          pusher=ImpactPusher(src))
+        memo.attach_impact(idx)
+        _scan(paths, cdb1, memo)
+
+        # identical re-compile: no delta, nothing newly affected
+        sw = SwappableStore(cdb1)
+        attach_memo(sw, memo)
+        sw.swap(CompiledDB.compile(adv1), stage=False)
+        assert src.get(timeout=0.0) is None
+
+        # a real delta adding a new advisory for an installed pkg:
+        # every image sharing the apk layer is newly affected
+        sw.swap(CompiledDB.compile(_mutate(rng, adv1)),
+                stage=False)
+        pushed = set()
+        while True:
+            ev = src.get(timeout=0.0)
+            if ev is None:
+                break
+            pushed.add(ev.path)
+        assert pushed == set(paths)
+
+
+# ------------------------------------------------------------------
+# federation: partial answers, never errors
+# ------------------------------------------------------------------
+
+class TestFederation:
+    @staticmethod
+    def _fetch_for(answers: dict):
+        def fetch(url, cve):
+            a = answers[url]
+            if isinstance(a, Exception):
+                raise a
+            return a
+        return fetch
+
+    def test_all_up_union_complete(self):
+        fetch = self._fetch_for({
+            "u1": {"cve": "CVE-1", "packages": ["p1"],
+                   "layers": ["b1"], "images": [["i1", ""]],
+                   "complete": True},
+            "u2": {"cve": "CVE-1", "packages": ["p2"],
+                   "layers": ["b2"], "images": [["i2", "acme"]],
+                   "complete": True}})
+        out = federated_impact([("r1", "u1"), ("r2", "u2")],
+                               "CVE-1", fetch=fetch)
+        assert out["complete"] is True
+        assert out["packages"] == ["p1", "p2"]
+        assert out["layers"] == ["b1", "b2"]
+        assert out["images"] == [["i1", ""], ["i2", "acme"]]
+
+    def test_one_peer_down_partial_not_error(self):
+        fetch = self._fetch_for({
+            "u1": {"cve": "CVE-1", "packages": ["p1"],
+                   "layers": ["b1"], "images": [["i1", ""]],
+                   "complete": True},
+            "u2": ConnectionError("replica down")})
+        out = federated_impact([("r1", "u1"), ("r2", "u2")],
+                               "CVE-1", fetch=fetch)
+        assert out["complete"] is False
+        assert out["packages"] == ["p1"]        # partial answer
+        rows = {r["replica"]: r for r in out["replicas"]}
+        assert rows["r1"]["up"] and not rows["r2"]["up"]
+        assert "down" in rows["r2"]["error"]
+
+    def test_degraded_peer_flags_incomplete(self):
+        fetch = self._fetch_for({
+            "u1": {"cve": "CVE-1", "packages": [], "layers": [],
+                   "images": [], "complete": False}})
+        out = federated_impact([("r1", "u1")], "CVE-1", fetch=fetch)
+        assert out["complete"] is False
+
+    def test_empty_fleet_is_complete_and_empty(self):
+        out = federated_impact([], "CVE-1",
+                               fetch=lambda u, c: {})
+        assert out["complete"] is True and out["images"] == []
+
+
+# ------------------------------------------------------------------
+# the HTTP surface: replica route, router fan-out, metrics
+# ------------------------------------------------------------------
+
+class TestHTTPSurface:
+    @staticmethod
+    def _get(url: str, token: str = ""):
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("Trivy-Token", token)
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                return resp.status, json.loads(
+                    resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode("utf-8"))
+
+    def test_replica_route_and_router_fanout(self, tmp_path):
+        from trivy_tpu.router.core import ScanRouter
+        from trivy_tpu.router.front import (RouterServer,
+                                            serve_router)
+        from trivy_tpu.rpc.server import ScanServer, serve
+
+        rng = np.random.default_rng(41)
+        paths = _fleet(tmp_path, 2)
+        cdb = CompiledDB.compile(_random_store(rng))
+        memo = FindingsMemo(MemoryMemoStore(), backend="cpu-ref")
+        idx = ImpactIndex(store=memo.store)
+        memo.attach_impact(idx)
+        _scan(paths, cdb, memo)
+        cves = sorted({c for _p, c, _b
+                       in idx.postings_snapshot()["postings"]})
+        assert cves
+        cve = cves[0]
+
+        srv = bare = None
+        httpd = httpd_b = httpd_r = None
+        front = None
+        try:
+            srv = ScanServer(token="t", impact=idx, memo=memo)
+            httpd, _ = serve(port=0, server=srv)
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+            code, doc = self._get(f"{url}/impact?cve={cve}",
+                                  token="t")
+            assert code == 200 and doc == idx.query(cve)
+            assert doc["images"], doc
+            code, doc = self._get(f"{url}/impact", token="t")
+            assert code == 400 and doc["code"] == "malformed"
+            code, _doc = self._get(f"{url}/impact?cve={cve}")
+            assert code == 401
+            # the JSON metrics snapshot carries the index section +
+            # the delta counters; the prom text renders them
+            stats = srv.metrics()
+            assert stats["impact"]["entries"] >= 1
+            assert "delta_touched" in stats["memo"]
+            text = srv.metrics_text()
+            assert "trivy_tpu_impact_pairs" in text
+            assert "trivy_tpu_delta_touched_total" in text
+            assert "trivy_tpu_watch_impact_rescans_total" in text
+
+            # a server WITHOUT an index answers 404, not a crash
+            bare = ScanServer(token="t")
+            httpd_b, _ = serve(port=0, server=bare)
+            url_b = f"http://127.0.0.1:{httpd_b.server_address[1]}"
+            code, doc = self._get(f"{url_b}/impact?cve={cve}",
+                                  token="t")
+            assert code == 404
+
+            # router fan-out: one live replica + one dead URL
+            # answers 200, partial, complete=False — never an error
+            router = ScanRouter(
+                [("up", url), ("down", "http://127.0.0.1:9")],
+                token="t")
+            front = RouterServer(router, token="t")
+            httpd_r, _ = serve_router(front, port=0)
+            url_r = f"http://127.0.0.1:{httpd_r.server_address[1]}"
+            code, doc = self._get(f"{url_r}/impact?cve={cve}",
+                                  token="t")
+            assert code == 200
+            assert doc["complete"] is False
+            ref = idx.query(cve)
+            assert doc["layers"] == ref["layers"]
+            assert doc["images"] == ref["images"]
+            rows = {r["replica"]: r for r in doc["replicas"]}
+            assert rows["up"]["up"] and not rows["down"]["up"]
+            code, doc = self._get(f"{url_r}/impact", token="t")
+            assert code == 400
+            code, _doc = self._get(f"{url_r}/impact?cve={cve}")
+            assert code == 401
+        finally:
+            for h in (httpd, httpd_b, httpd_r):
+                if h is not None:
+                    h.shutdown()
+            if front is not None:
+                front.close()
+            for s in (srv, bare):
+                if s is not None:
+                    s.close()

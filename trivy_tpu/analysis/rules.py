@@ -756,7 +756,7 @@ class BareExceptRule(Rule):
              "trivy_tpu/artifact/", "trivy_tpu/memo/",
              "trivy_tpu/obs/", "trivy_tpu/guard/",
              "trivy_tpu/faults/", "trivy_tpu/parallel/",
-             "trivy_tpu/router/")
+             "trivy_tpu/router/", "trivy_tpu/impact/")
 
     @staticmethod
     def _is_silent(handler: ast.ExceptHandler) -> bool:

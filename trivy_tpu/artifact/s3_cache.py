@@ -119,10 +119,70 @@ class S3Client:
             return resp.status, data
         raise S3Error(f"s3 {method} {key}: {last_err}")
 
+    def list_keys(self, prefix: str, max_keys: int = 0) -> tuple:
+        """ListObjectsV2 under ``prefix`` → (keys, complete).
+
+        Pages through continuation tokens; a positive ``max_keys``
+        stops paging early and reports ``complete=False`` when more
+        pages remain — the bounded-iteration contract memo
+        ``scan_keys`` (and the impact-index rebuild) relies on."""
+        import html
+        import re
+        keys: list = []
+        token = ""
+        while True:
+            params = {"list-type": "2", "prefix": prefix}
+            if token:
+                params["continuation-token"] = token
+            status, body = self._request_query("GET", params)
+            if status >= 300:
+                raise S3Error(f"s3 list {prefix}: HTTP {status}")
+            text = body.decode("utf-8", "replace")
+            keys.extend(html.unescape(m) for m in
+                        re.findall(r"<Key>(.*?)</Key>", text))
+            truncated = re.search(
+                r"<IsTruncated>\s*true\s*</IsTruncated>", text)
+            nxt = re.search(r"<NextContinuationToken>(.*?)"
+                            r"</NextContinuationToken>", text)
+            if not truncated or nxt is None:
+                return keys, True
+            if max_keys and len(keys) >= max_keys:
+                return keys, False
+            token = html.unescape(nxt.group(1))
+
+    def _request_query(self, method: str, params: dict) -> tuple:
+        """A bucket-level request with a query string (the object
+        request() path can't express one: its signer hardcodes an
+        empty canonical query)."""
+        path = f"/{self.bucket}" if self.path_style else "/"
+        query = "&".join(
+            f"{quote(k, safe='-_.~')}={quote(str(v), safe='-_.~')}"
+            for k, v in sorted(params.items()))
+        headers = {"Host": self.host, "Content-Length": "0"}
+        if self.access_key and self.secret_key:
+            self._sign(method, path, headers, b"", query=query)
+        last_err = None
+        for _ in range(2):
+            conn = self._conn or self._connect()
+            self._conn = None
+            try:
+                conn.request(method, f"{path}?{query}",
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                last_err = e
+                continue
+            self._conn = conn
+            return resp.status, data
+        raise S3Error(f"s3 {method} {path}?{query}: {last_err}")
+
     def _sign(self, method: str, path: str, headers: dict,
-              body: bytes) -> None:
+              body: bytes, query: str = "") -> None:
         """AWS Signature Version 4 (the aws-sdk-go default signer
-        the reference relies on)."""
+        the reference relies on). ``query`` must already be the
+        canonical form: sorted, percent-encoded pairs."""
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
         date = now.strftime("%Y%m%d")
@@ -139,7 +199,7 @@ class S3Client:
             f"{k}:{lowered[k]}\n" for k in signed)
         signed_list = ";".join(signed)
         canonical = "\n".join([
-            method, path, "", canonical_headers, signed_list,
+            method, path, query, canonical_headers, signed_list,
             payload_hash])
         scope = f"{date}/{self.region}/s3/aws4_request"
         to_sign = "\n".join([

@@ -443,6 +443,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="findings-memo backend override "
                      "('memory', a directory, redis:// or s3://); "
                      "default persists under --cache-dir")
+    srv.add_argument("--impact-index", action="store_true",
+                     help="maintain the inverted (package, CVE) -> "
+                     "layers -> images findings index as a write-"
+                     "through side effect of the memo tier, rebuild "
+                     "it from the shared tier on boot, and serve "
+                     "GET /impact?cve= (docs/serving.md 'CVE impact "
+                     "queries & push re-scans'); requires the memo")
     srv.add_argument("--sched", default="on",
                      choices=["on", "off"],
                      help="coalesce concurrent Scan RPCs through "
@@ -603,6 +610,18 @@ def build_parser() -> argparse.ArgumentParser:
     mu.add_argument("name")
     modsub.add_parser("list")
 
+    imp = sub.add_parser(
+        "impact", help="ask a replica server or the router fleet "
+        "front which layers/images a CVE affects "
+        "(GET /impact?cve=, docs/serving.md 'CVE impact queries & "
+        "push re-scans')")
+    imp.add_argument("--server", required=True,
+                     help="server or router base URL")
+    imp.add_argument("--cve", required=True)
+    imp.add_argument("--token", dest="auth_token", default="")
+    imp.add_argument("--token-header", default="Trivy-Token")
+    imp.add_argument("--timeout", type=float, default=5.0)
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -610,7 +629,7 @@ def build_parser() -> argparse.ArgumentParser:
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
                    "sbom", "k8s", "aws", "db", "server", "route",
                    "watch", "plugin", "config", "conf", "module",
-                   "m", "client", "c", "version")
+                   "m", "client", "c", "impact", "version")
 
 
 def main(argv=None) -> int:
@@ -752,7 +771,28 @@ def _dispatch(args) -> int:
         return run_plugin(args)
     if args.command == "aws":
         return run_aws(args)
+    if args.command == "impact":
+        return run_impact(args)
     return 2
+
+
+def run_impact(args) -> int:
+    """``trivy-tpu impact --server URL --cve ID``: one HTTP query
+    against a replica's slice or the router front's federated
+    union. A partial answer (``complete: false``) still exits 0 —
+    Federator semantics; the flag is the caller's signal."""
+    import urllib.error
+    from .impact.federate import fetch_impact
+    try:
+        out = fetch_impact(args.server, args.cve,
+                           token=args.auth_token,
+                           token_header=args.token_header,
+                           timeout_s=args.timeout)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"error: impact query: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
 
 
 def run_aws(args) -> int:
@@ -1035,13 +1075,29 @@ def run_server(args) -> int:
             timeout_s=getattr(args, "federate_timeout", 2.0),
             stale_after_s=getattr(args, "federate_stale_after",
                                   60.0))
+    memo = _memo(args, injector=injector)
+    impact = None
+    if getattr(args, "impact_index", False):
+        if memo is None:
+            print("error: --impact-index needs the findings memo "
+                  "(drop --no-memo)", file=sys.stderr)
+            return 2
+        from .impact import ImpactIndex
+        impact = ImpactIndex(
+            store=memo.store,
+            name=getattr(args, "replica_name", "") or args.listen)
+        # a restarted / rescheduled replica recovers its slice from
+        # the shared memo tier before taking queries — the
+        # elasticity story (docs/serving.md)
+        impact.rebuild(memo, store)
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
                         token_header=args.token_header,
                         sched=sched,
                         slos=None if scheduler is not None else slos,
-                        memo=_memo(args, injector=injector),
+                        memo=memo,
+                        impact=impact,
                         federator=federator,
                         replica_name=(
                             getattr(args, "replica_name", "")

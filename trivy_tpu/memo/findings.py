@@ -74,6 +74,9 @@ class MemoPlan:
     refs: list = field(default_factory=list)       # keep ids stable
     queries_hit: int = 0
     queries_miss: int = 0
+    # the generation this partition keyed against — resolve derives
+    # impact-index postings from stored entries under the SAME db
+    db: object = None
 
 
 class FindingsMemo:
@@ -108,6 +111,16 @@ class FindingsMemo:
         self._lock = threading.Lock()
         self._journal: set = set()
         self._ctx_cache: dict = {}
+        # optional inverted impact index (impact/index.py): memo
+        # stores/evictions/migrations mirror into it write-through
+        self.impact = None
+
+    def attach_impact(self, index) -> None:
+        """Wire an :class:`impact.index.ImpactIndex`: every entry
+        store, corrupt drop, and hot-swap migration from here on
+        maintains the inverted (package, CVE) → layers index as a
+        side effect."""
+        self.impact = index
 
     # ---- context ----
 
@@ -154,6 +167,8 @@ class FindingsMemo:
             log.warning("dropping corrupt memo entry %s: %r",
                         key[:16], e)
             self.store.delete(key)
+            if self.impact is not None:
+                self.impact.drop_entry(key)
             return None
         with self._lock:
             self._journal.add(key)
@@ -214,7 +229,16 @@ class FindingsMemo:
         opts = K.opts_sig(options)
         jobs = prepared.jobs
         plan = MemoPlan()
+        plan.db = db
         drop: set = set()
+        if self.impact is not None:
+            # image → memoizable-layer edge for the inverted index
+            # (tenant rides PreparedScan from the server's scope)
+            self.impact.observe_image(
+                getattr(target, "name", "")
+                or getattr(target, "artifact_id", ""),
+                sorted(groups),
+                tenant=getattr(prepared, "tenant", ""))
         from ..obs.trace import phase_span
         with phase_span("memo_lookup", layers=len(groups),
                         queries=len(queries)):
@@ -299,6 +323,12 @@ class FindingsMemo:
                         sub["n"] = n_jobs
                         entry["subs"][qsig] = sub
                     self._store(key, entry)
+                    if self.impact is not None and \
+                            plan.db is not None:
+                        from ..impact.index import entry_postings
+                        self.impact.set_entry(
+                            key, entry["blob"],
+                            entry_postings(entry, plan.db))
         return detected + plan.hits
 
     # ---- db hot swap (docs/performance.md) ----
@@ -321,8 +351,13 @@ class FindingsMemo:
             # stop matching the new context and age out
             return out
         try:
-            with phase_span("delta_rematch"):
+            with phase_span("delta_rematch") as sp:
                 out = self._hot_swap(old_db, new_db)
+                delta_stats = out.get("delta") or {}
+                sp.set("touched_keys",
+                       delta_stats.get("touched_keys", 0))
+                sp.set("rematch_entries", out["rematch_entries"])
+                sp.set("rematch_jobs", out["rematch_jobs"])
         except Exception as e:      # noqa: BLE001 — a failed
             # migration must never break the swap; the store is
             # still correct (old-ctx entries are unreachable under
@@ -336,6 +371,7 @@ class FindingsMemo:
         from ..detect.rematch import build_rematch_jobs
 
         delta = advisory_delta(old_db, new_db)
+        MEMO_METRICS.inc("delta_touched", len(delta.touched))
         old_ctx = self.ctx_for(old_db)
         new_ctx = self.ctx_for(new_db)
         out = {"migrated": 0, "rematch_entries": 0,
@@ -349,6 +385,12 @@ class FindingsMemo:
         jobs: list = []
         updates: list = []          # (new_key, old_key, entry)
         for key in keys:
+            if key.startswith("impact-"):
+                # impact-index image records ride the same store
+                # (impact.index.IMPACT_KEY_PREFIX) but are not memo
+                # entries — _load would reject their envelope as
+                # corrupt and DELETE them
+                continue
             entry = self._load(key)
             if entry is None or entry.get("ctx") != old_ctx:
                 continue
@@ -361,6 +403,10 @@ class FindingsMemo:
                                         sub.get("name", ""))]
             if not touched:
                 self._store(new_key, entry)
+                if self.impact is not None:
+                    # delta-untouched: same advisory content, same
+                    # verdicts — postings carry over by rename
+                    self.impact.rename_entry(key, new_key)
                 self._drop_old(key, new_key)
                 out["migrated"] += 1
                 continue
@@ -386,16 +432,34 @@ class FindingsMemo:
                                      mesh=self.mesh, stats={})
             for ui, qsig, li in detected:
                 updates[ui][2]["subs"][qsig]["hits"].append(li)
+        new_blobs: set = set()
         for new_key, old_key, entry in updates:
             for sub in entry["subs"].values():
                 sub["hits"] = sorted(sub.get("hits", []))
             self._store(new_key, entry)
+            if self.impact is not None:
+                from ..impact.index import entry_postings
+                # rename first so the set_entry diff runs against
+                # the old postings — only genuinely NEW (pkg, CVE)
+                # pairs trigger the push stream
+                self.impact.rename_entry(old_key, new_key)
+                added = self.impact.set_entry(
+                    new_key, entry["blob"],
+                    entry_postings(entry, new_db))
+                if added:
+                    new_blobs.add(entry["blob"])
             self._drop_old(old_key, new_key)
         out["rematch_entries"] = len(updates)
         out["rematch_jobs"] = len(jobs)
         MEMO_METRICS.inc("rematch_jobs", len(jobs))
         MEMO_METRICS.inc("rematch_entries", len(updates))
         MEMO_METRICS.inc("migrated_entries", out["migrated"])
+        MEMO_METRICS.inc("delta_rematched", out["invalidated_subs"])
+        MEMO_METRICS.inc("delta_invalidated", out["dropped_subs"])
+        if self.impact is not None and new_blobs:
+            # each shard emits its newly-affected image set as
+            # high-priority, tenant-scoped re-scans (impact/push.py)
+            out["push_images"] = self.impact.emit_push(new_blobs)
         if updates or out["migrated"]:
             log.info("memo hot-swap: %d migrated, %d re-matched "
                      "entries (%d jobs), %d subs invalidated",
@@ -410,6 +474,10 @@ class FindingsMemo:
         if old_key == new_key:
             return
         self.store.delete(old_key)
+        if self.impact is not None:
+            # no-op when the entry was renamed first — covers any
+            # future caller that drops without migrating
+            self.impact.drop_entry(old_key)
         with self._lock:
             self._journal.discard(old_key)
 

@@ -34,6 +34,12 @@ class MemoMetrics:
         # packages, swaps processed
         "migrated_entries", "rematch_jobs", "rematch_entries",
         "swaps",
+        # advisory-delta observability (ISSUE 16): advisory keys the
+        # delta touched, sub-records re-matched against the new
+        # generation, sub-records invalidated outright (no longer
+        # evaluable — recompute on next scan). Exposed as
+        # trivy_tpu_delta_{touched,rematched,invalidated}_total.
+        "delta_touched", "delta_rematched", "delta_invalidated",
     )
 
     def __init__(self):

@@ -28,6 +28,16 @@ from .metrics import MEMO_METRICS
 log = get_logger("memo.store")
 
 
+def _cap(keys: list, limit: int) -> tuple:
+    """Shared ``scan_keys`` bounding: a positive ``limit`` truncates
+    and reports the iteration incomplete — the caller (index rebuild)
+    must not mistake a capped page for the whole keyspace."""
+    keys = sorted(keys)
+    if limit and len(keys) > limit:
+        return keys[:limit], False
+    return keys, True
+
+
 class MemoryMemoStore:
     """In-process store — the default for MemoryCache-backed runs."""
 
@@ -50,6 +60,11 @@ class MemoryMemoStore:
     def keys(self) -> list:
         with self._lock:
             return sorted(self._d)
+
+    def scan_keys(self, prefix: str = "", limit: int = 0) -> tuple:
+        with self._lock:
+            keys = [k for k in self._d if k.startswith(prefix)]
+        return _cap(keys, limit)
 
 
 class FSMemoStore:
@@ -94,6 +109,15 @@ class FSMemoStore:
             return []
         return sorted(n[:-5] for n in names if n.endswith(".json"))
 
+    def scan_keys(self, prefix: str = "", limit: int = 0) -> tuple:
+        # unlike keys(), an unreadable directory RAISES so the
+        # resilient wrapper can flag the iteration incomplete — a
+        # rebuild must distinguish "empty store" from "can't look"
+        names = os.listdir(self.dir)
+        return _cap([n[:-5] for n in names
+                     if n.endswith(".json")
+                     and n[:-5].startswith(prefix)], limit)
+
 
 class RedisMemoStore:
     """Raw-bytes entries on the blob cache's own Redis connection
@@ -122,6 +146,28 @@ class RedisMemoStore:
     def keys(self):
         return None          # no cheap enumeration — journal only
 
+    def scan_keys(self, prefix: str = "", limit: int = 0) -> tuple:
+        """Bounded SCAN cursor walk — O(page) per round trip, never
+        the O(keyspace) blocking KEYS."""
+        ns = self._key(prefix)
+        keys, cursor = [], "0"
+        while True:
+            reply = self.cache.client.command(
+                "SCAN", cursor, "MATCH", ns + "*", "COUNT", "512")
+            if not isinstance(reply, (list, tuple)) or len(reply) != 2:
+                raise ConnectionError(f"bad SCAN reply: {reply!r}")
+            cursor_raw, page = reply
+            cursor = cursor_raw.decode() \
+                if isinstance(cursor_raw, bytes) else str(cursor_raw)
+            for k in page or []:
+                if isinstance(k, bytes):
+                    k = k.decode("utf-8", "replace")
+                keys.append(k[len("fanal::memo::"):])
+            if cursor == "0":
+                return _cap(keys, limit)
+            if limit and len(keys) >= limit:
+                return sorted(keys)[:limit], False
+
 
 class S3MemoStore:
     """Raw-bytes entries as ``memo/<key>`` objects in the blob
@@ -147,6 +193,19 @@ class S3MemoStore:
 
     def keys(self):
         return None          # journal only
+
+    def scan_keys(self, prefix: str = "", limit: int = 0) -> tuple:
+        ns = self._key(prefix)
+        # strip the trailing key part back off to find the object
+        # prefix that _key() prepends (bucket/prefix layout differs
+        # between S3Cache and the bare fallback)
+        base = ns[:len(ns) - len(prefix)]
+        objs, complete = self.cache.client.list_keys(
+            ns, max_keys=limit or 0)
+        keys = [o[len(base):] for o in objs if o.startswith(base)]
+        if limit and len(keys) > limit:
+            return sorted(keys)[:limit], False
+        return sorted(keys), complete
 
 
 class ResilientMemoStore:
@@ -219,6 +278,24 @@ class ResilientMemoStore:
             return None
         self.breaker.record_success()
         return keys
+
+    def scan_keys(self, prefix: str = "",
+                  limit: int = 0) -> tuple:
+        """(keys, complete) — Federator semantics: an outage yields a
+        PARTIAL answer flagged ``complete=False``, never an error.
+        Index rebuilds treat an incomplete scan as a degraded slice,
+        not as ground truth."""
+        if not hasattr(self.primary, "scan_keys"):
+            keys = self.keys()          # duck-typed stores: best
+            if keys is None:            # effort via full keys()
+                return [], False
+            return _cap([k for k in keys
+                         if k.startswith(prefix)], limit)
+        ok, v = self._op("scan_keys", prefix, limit)
+        if not ok or v is None:
+            return [], False
+        keys, complete = v
+        return list(keys), bool(complete)
 
     def breaker_stats(self) -> dict:
         with self._lock:

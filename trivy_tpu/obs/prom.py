@@ -324,6 +324,20 @@ def render_prometheus(stats: dict, phase_hists=None,
                   "rematch_jobs", "rematch_entries", "swaps"):
             if k in memo:
                 w.sample(name, [("event", k)], memo[k])
+        # advisory-delta observability (docs/serving.md "CVE impact
+        # queries & push re-scans"): how much of the memo tier a DB
+        # hot swap actually touched
+        for k, help_ in (
+                ("delta_touched",
+                 "Advisory keys touched by hot-swap deltas."),
+                ("delta_rematched",
+                 "Memo sub-records re-matched against the new "
+                 "generation."),
+                ("delta_invalidated",
+                 "Memo sub-records invalidated outright (recompute "
+                 "on next scan).")):
+            w.scalar(f"{_PREFIX}_{k}_total", "counter", help_,
+                     memo.get(k))
 
     watch = stats.get("watch") or {}
     if watch:
@@ -341,7 +355,9 @@ def render_prometheus(stats: dict, phase_hists=None,
                 ("shed", "Events shed by admission backpressure "
                  "or unresolvable references."),
                 ("malformed", "Malformed registry notifications "
-                 "counted and dropped at the parse boundary.")):
+                 "counted and dropped at the parse boundary."),
+                ("impact_rescans", "High-priority re-scans pushed "
+                 "by the impact index after a DB hot swap.")):
             w.scalar(f"{_PREFIX}_watch_{k}_total", "counter",
                      help_, watch.get(k))
         name = f"{_PREFIX}_watch_events_detail_total"
@@ -375,6 +391,44 @@ def render_prometheus(stats: dict, phase_hists=None,
         w.scalar(f"{_PREFIX}_admission_cache_hit_rate", "gauge",
                  "Admission verdict-cache hit rate.",
                  watch.get("admission_cache_hit_rate"))
+
+    impact = stats.get("impact") or {}
+    if impact:
+        # inverted findings index (docs/serving.md "CVE impact
+        # queries & push re-scans"): slice size gauges, query/
+        # maintenance totals, bookkeeping events
+        for k, help_ in (
+                ("entries",
+                 "Memo entries currently contributing postings."),
+                ("pairs",
+                 "Distinct (package, CVE) postings resident."),
+                ("cves", "Distinct CVE ids resident."),
+                ("images", "Images with a recorded layer set.")):
+            w.scalar(f"{_PREFIX}_impact_{k}", "gauge", help_,
+                     impact.get(k))
+        w.scalar(f"{_PREFIX}_impact_complete", "gauge",
+                 "1 while the index covers the full memo tier "
+                 "(the last rebuild's key scan finished).",
+                 1 if impact.get("complete", True) else 0)
+        w.scalar(f"{_PREFIX}_impact_queries_total", "counter",
+                 "Local impact-slice queries served.",
+                 impact.get("queries"))
+        w.scalar(f"{_PREFIX}_impact_maintenance_seconds_total",
+                 "counter",
+                 "Wall seconds of write-through index maintenance "
+                 "(the <2% overhead budget's numerator).",
+                 impact.get("maintenance_s"))
+        name = f"{_PREFIX}_impact_events_total"
+        w.header(name, "counter",
+                 "Impact-index bookkeeping (entry updates/drops/"
+                 "renames, image-record persistence, rebuilds, "
+                 "push stream).")
+        for k in ("updates", "drops", "renames", "image_updates",
+                  "persist_puts", "persist_skips", "rebuilds",
+                  "rebuild_entries", "rebuild_degraded",
+                  "push_batches", "push_images"):
+            if k in impact:
+                w.sample(name, [("event", k)], impact[k])
 
     tenants = stats.get("tenants") or {}
     if tenants:

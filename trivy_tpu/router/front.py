@@ -65,6 +65,19 @@ class RouterServer:
         return render_router(self.metrics(),
                              hists=ROUTER_METRICS.hist_snapshot())
 
+    def impact(self, cve: str) -> dict:
+        """``GET /impact?cve=`` — federated union of every replica's
+        owned index slice (impact/federate.py). The ring partitions
+        the layer-digest space, so the union over answering replicas
+        is exact for their slices; a down replica makes the answer
+        partial (``complete: false``), never an error."""
+        from ..impact.federate import federated_impact
+        return federated_impact(
+            [(h.name, h.url) for h in self.router.replicas()],
+            cve,
+            token=self.router.token,
+            token_header=self.router.token_header)
+
     def close(self) -> None:
         if self.scaler is not None:
             self.scaler.stop()
@@ -126,6 +139,18 @@ def _make_handler(front: RouterServer):
                     "replicas": [h.stats()
                                  for h in front.router.replicas()],
                     "ring": front.router.stats()["ring"]})
+            elif self.path.startswith("/impact"):
+                if not self._authorized():
+                    return
+                from urllib.parse import parse_qs, urlsplit
+                q = parse_qs(urlsplit(self.path).query)
+                cve = (q.get("cve") or [""])[0].strip()
+                if not cve:
+                    self._reply(400, {
+                        "code": "malformed",
+                        "msg": "missing cve= query parameter"})
+                    return
+                self._reply(200, front.impact(cve[:256]))
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})

@@ -199,7 +199,8 @@ class ScanServer:
                  max_scan_blobs: int = MAX_SCAN_BLOBS,
                  tracer=None, slos=None, memo=None,
                  admission=None, watch_source=None,
-                 federator=None, replica_name: str = "self"):
+                 federator=None, replica_name: str = "self",
+                 impact=None):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -295,6 +296,12 @@ class ScanServer:
         # and serves the merged exposition + fleet SLO verdicts
         self.federator = federator
         self.replica_name = replica_name
+        # inverted impact index (docs/serving.md "CVE impact
+        # queries"): GET /impact?cve= answers this replica's owned
+        # slice; the memo maintains the index write-through
+        self.impact = impact
+        if impact is not None and memo is not None:
+            memo.attach_impact(impact)
 
     def build_info(self) -> dict:
         """The trivy_tpu_build_info identity labels (also mirrored
@@ -441,7 +448,8 @@ class ScanServer:
         try:
             with root.activate():
                 scanner = LocalScanner(self.cache, db,
-                                       memo=self.memo)
+                                       memo=self.memo,
+                                       tenant=tenant)
                 results, os_found = scanner.scan(target, options)
         except BaseException:
             root.end("failed")
@@ -468,10 +476,11 @@ class ScanServer:
         from ..sched import AnalyzedWork, ScanRequest
 
         db = self.store.acquire()
+        tenant = _clean_tenant(body.get("tenant"))
 
         def analyze(req):
             scanner = LocalScanner(self.cache, db,
-                                       memo=self.memo)
+                                   memo=self.memo, tenant=tenant)
             prepared = scanner.prepare(target, options)
 
             def finish(found, detected):
@@ -498,7 +507,7 @@ class ScanServer:
             # header the handler folded in): the scheduler's WFQ
             # orders per tenant, quotas answer 429 + Retry-After.
             # Priority jumps the line only WITHIN the tenant.
-            tenant=_clean_tenant(body.get("tenant")),
+            tenant=tenant,
             priority=max(-100, min(100, priority)),
             # the client's propagated context rides the body
             # (traceparent, or the legacy bare trace_id); the
@@ -564,6 +573,10 @@ class ScanServer:
             out["watch"] = WATCH_METRICS.snapshot()
         if self.admission is not None:
             out["admission_controller"] = self.admission.stats()
+        if self.impact is not None:
+            # inverted-index gauges + maintenance counters
+            # (docs/serving.md "CVE impact queries")
+            out["impact"] = self.impact.stats()
         if "slo" not in out:
             out["slo"] = self.slo.snapshot()
         out["profiler"] = self.profiler.stats()
@@ -644,6 +657,15 @@ class ScanServer:
         return self.federator.render(
             self.replica_name, self.metrics_text(), rows,
             fleet=fleet)
+
+    def impact_query(self, cve: str) -> dict:
+        """The ``GET /impact?cve=`` payload: this replica's owned
+        slice of layers/images affected by one CVE. Raises
+        LookupError when the server runs without an impact index
+        (mirrors ``federate_text``'s unconfigured contract)."""
+        if self.impact is None:
+            raise LookupError("impact index not configured")
+        return self.impact.query(cve)
 
     def profile_text(self, seconds=None) -> str:
         """Collapsed-stack host profile over the last ``seconds``
@@ -832,6 +854,27 @@ def _make_handler(server: ScanServer):
                 self._reply_text(
                     200, server.profile_text(seconds),
                     "text/plain; charset=utf-8")
+            elif self.path.startswith("/impact"):
+                # CVE impact query (docs/serving.md "CVE impact
+                # queries"): this replica's owned index slice —
+                # token-gated operational data like /metrics
+                if not self._authorized():
+                    return
+                from urllib.parse import parse_qs, urlsplit
+                q = parse_qs(urlsplit(self.path).query)
+                cve = (q.get("cve") or [""])[0].strip()
+                if not cve:
+                    self._reply(400, {"code": "malformed",
+                                      "msg": "missing cve= query "
+                                             "parameter"})
+                    return
+                try:
+                    self._reply(200, server.impact_query(cve[:256]))
+                except LookupError:
+                    self._reply(404, {
+                        "code": "bad_route",
+                        "msg": "impact index not configured "
+                               "(--impact-index)"})
             elif self.path.startswith("/trace/"):
                 # per-request trace lookup (docs/observability.md):
                 # operational detail, so it honors the token too

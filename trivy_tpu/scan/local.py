@@ -57,11 +57,15 @@ class PreparedScan:
     # resolves — both None when no memo is attached
     queries: Optional[list] = None
     memo_plan: object = None
+    # requester identity (server tenant scope) — the impact index
+    # records it per image so hot-swap push re-scans stay
+    # tenant-scoped
+    tenant: str = ""
 
 
 class LocalScanner:
     def __init__(self, cache, store: Optional[AdvisoryStore] = None,
-                 memo=None):
+                 memo=None, tenant: str = ""):
         self.cache = cache
         self.store = store or AdvisoryStore()
         self.compiled: Optional[CompiledDB] = \
@@ -70,6 +74,7 @@ class LocalScanner:
         # verdicts served without device dispatch when the exact
         # question was answered before (docs/performance.md)
         self.memo = memo
+        self.tenant = tenant
 
     def scan(self, target: ScanTarget, options: ScanOptions) -> tuple:
         """Returns (results, os) — single-target convenience around
@@ -124,7 +129,8 @@ class LocalScanner:
         prepared = PreparedScan(target=target, options=options,
                                 detail=detail, jobs=jobs, eosl=eosl,
                                 pkg_results=pkg_results,
-                                queries=queries)
+                                queries=queries,
+                                tenant=self.tenant)
         if self.memo is not None and jobs:
             # hit/miss partition: verdicts answered before are
             # served at finish; only novel queries keep their jobs

@@ -38,6 +38,10 @@ class WatchMetrics:
         # events whose image reference no resolver could map to a
         # scannable target (disposed as shed)
         "unresolvable",
+        # hot-swap impact push stream: re-scan events enqueued by
+        # impact/push.py (each then disposes normally as
+        # scans/deduped/shed — this counts the stream's input side)
+        "impact_rescans",
         # -- admission webhook verdict counters
         "admission_allow", "admission_deny", "admission_fail_open",
         "admission_timeout", "admission_reviews",

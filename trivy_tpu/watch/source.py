@@ -187,6 +187,24 @@ class WebhookSource(EventSource):
         return {"accepted": len(events), "malformed": malformed,
                 "dropped": self.dropped}
 
+    def push_events(self, events) -> int:
+        """Enqueue already-built :class:`PushEvent`\\ s (the impact
+        push stream's entry point) with the same seq-assignment and
+        bounded-overflow semantics as webhook notifications — a swap
+        storm buffers bounded and folds into the loop's debounce like
+        any other burst."""
+        events = list(events)
+        with self._cv:
+            for ev in events:
+                ev.seq = self._seq
+                self._seq += 1
+                if len(self._q) == self._q.maxlen:
+                    self.dropped += 1
+                    self._dropped_seqs.append(self._q[0].seq)
+                self._q.append(ev)
+            self._cv.notify_all()
+        return len(events)
+
     def get(self, timeout: float = 0.05):
         with self._cv:
             if not self._q:
