@@ -2971,6 +2971,161 @@ def bench_impact() -> dict:
     return out
 
 
+def _storm_baseline_ips(replicas: int, service_ms: float,
+                        max_concurrent: int, n: int = 1500,
+                        n_threads: int = 12) -> float:
+    """Closed-loop direct-storm throughput of an N-replica sim
+    fleet — the steady-state reference the soak's sustained rate is
+    gated against (same fleet shape, no scenario in the way)."""
+    import hashlib
+    import threading
+    import uuid
+
+    from trivy_tpu.router.core import SCAN_PATH, ScanRouter
+    from trivy_tpu.router.metrics import ROUTER_METRICS
+    from trivy_tpu.router.scaler import SimReplicaController
+
+    ROUTER_METRICS.reset()
+    ctl = SimReplicaController(prefix="base",
+                               service_ms=service_ms,
+                               max_concurrent=max_concurrent)
+    router = ScanRouter()
+    try:
+        for _ in range(replicas):
+            name, url = ctl.start()
+            router.add_replica(name, url)
+        digests = ["sha256:" + hashlib.sha256(
+            f"base:{i}".encode()).hexdigest() for i in range(n)]
+
+        def raw(d):
+            return json.dumps(
+                {"idempotency_key": uuid.uuid4().hex,
+                 "target": f"img:{d[7:19]}",
+                 "artifact_id": "sha256:art-" + d[-12:],
+                 "blob_ids": [d]}).encode()
+
+        oks, lock = [0], threading.Lock()
+
+        def worker(chunk):
+            for d in chunk:
+                status, _, _ = router.route(SCAN_PATH, raw(d))
+                if status == 200:
+                    with lock:
+                        oks[0] += 1
+
+        threads = [threading.Thread(target=worker,
+                                    args=(digests[i::n_threads],))
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert oks[0] == n, f"baseline storm lost scans " \
+            f"({oks[0]}/{n} ok)"
+        return oks[0] / dt
+    finally:
+        for name in list(ctl.replicas):
+            ctl.stop(name)
+        ROUTER_METRICS.reset()
+
+
+def bench_soak_smoke() -> dict:
+    """Minutes-scale soak gate (docs/robustness.md "Soak & chaos
+    testing") — the harness exercising itself on every PR:
+
+    * **books** — fleet-wide zero loss through a kill, a scale
+      cycle, a rolling hot swap and an event storm: router
+      ``lost == 0`` and the watch loop's event accounting balances;
+    * **trips exactly** — the fleet SLO holds through every scripted
+      disruption EXCEPT the designed brownout, which must trip,
+      with flight-recorder dumps as the evidence trail;
+    * **leak audit** — every gated resource series flat after
+      warm-up;
+    * **determinism** — same seed ⇒ byte-identical schedule AND
+      byte-identical stable report slice across two full runs.
+    """
+    from trivy_tpu.soak import load_scenario, run_soak
+    from trivy_tpu.soak.runner import stable_view
+
+    out: dict = {}
+    s1, s2 = load_scenario("soak-smoke"), load_scenario("soak-smoke")
+    assert s1.to_json() == s2.to_json(), \
+        "same-seed schedules differ"
+    assert s1.digest() == s2.digest()
+    out["schedule_digest"] = s1.digest()
+    out["arrivals"] = len(s1.schedule()["arrivals"])
+
+    reports = []
+    for _ in range(2):
+        reports.append(run_soak(load_scenario("soak-smoke"),
+                                replicas=3, epoch_s=0.5,
+                                service_ms=3.0))
+    rep = reports[0]
+    st = rep["stable"]
+    assert st["books_balanced"] and st["lost"] == 0, \
+        f"soak books: {rep['books']}"
+    assert st["trips_exact"], \
+        f"designed trip not exact: {rep['slo']['trip']}"
+    assert rep["slo"]["trip"]["dumps"] > 0, \
+        "designed trip left no flight-recorder evidence"
+    assert st["audit_ok"], f"leak audit: {rep['audit']}"
+    assert stable_view(reports[0]) == stable_view(reports[1]), \
+        "same-seed soak reports diverge in the stable slice:\n" \
+        f"{stable_view(reports[0])}\n{stable_view(reports[1])}"
+    out["scans_ok"] = rep["books"]["counters"]["scans_ok"]
+    out["dumps"] = rep["slo"]["trip"]["dumps"]
+    out["stable_identical"] = True
+    out["wall_s"] = rep["wall"]["duration_s"]
+    return out
+
+
+def bench_soak() -> dict:
+    """The full gated soak: a compressed "week" (720 virtual s at
+    6x) against a million-layer registry. Gates everything the
+    smoke gates, PLUS:
+
+    * >= 10^4 scans through the fleet;
+    * peak RSS bounded — no monotone growth across the run;
+    * sustained steady-state goodput within 10% of min(direct-storm
+      baseline at equivalent N, the offered steady rate) — chaos
+      recovery never degrades the quiet periods.
+    """
+    from trivy_tpu.soak import load_scenario, run_soak
+
+    out: dict = {}
+    baseline = _storm_baseline_ips(replicas=3, service_ms=3.0,
+                                   max_concurrent=4)
+    out["baseline_ips"] = round(baseline, 2)
+
+    rep = run_soak(load_scenario("soak"), replicas=3, epoch_s=1.0,
+                   service_ms=3.0)
+    st = rep["stable"]
+    assert st["books_balanced"] and st["lost"] == 0, \
+        f"soak books: {rep['books']}"
+    assert st["trips_exact"], \
+        f"designed trip not exact: {rep['slo']['trip']}"
+    assert rep["slo"]["trip"]["dumps"] > 0
+    assert st["audit_ok"], f"leak audit: {rep['audit']}"
+    accepted = rep["books"]["router"]["accepted"]
+    assert accepted >= 10_000, \
+        f"soak too small to judge leaks: {accepted} scans"
+    sustained = rep["throughput"]["sustained"]
+    target = 0.9 * min(baseline, sustained["offered_ips"])
+    assert sustained["ips"] >= target, \
+        f"sustained {sustained['ips']} ips < 0.9 x " \
+        f"min(baseline {baseline:.1f}, " \
+        f"offered {sustained['offered_ips']})"
+    out["scans"] = accepted
+    out["sustained_ips"] = sustained["ips"]
+    out["offered_ips"] = sustained["offered_ips"]
+    out["rss_peak"] = rep["audit"]["series"].get(
+        "rss_bytes", {}).get("peak")
+    out["wall_s"] = rep["wall"]["duration_s"]
+    return out
+
+
 def _run_config(cfg: str) -> dict:
     return {"images": bench_images, "sboms": bench_sboms,
             "mesh": bench_mesh_scaling,
@@ -2984,6 +3139,8 @@ def _run_config(cfg: str) -> dict:
             "watch": bench_watch,
             "witness": bench_witness,
             "router": bench_router,
+            "soak-smoke": bench_soak_smoke,
+            "soak": bench_soak,
             "impact": bench_impact}[cfg]()
 
 
@@ -3039,6 +3196,9 @@ def main() -> None:
     witness = _subprocess_config("witness")
     router = _subprocess_config("router")
     impact = _subprocess_config("impact")
+    # the minutes-scale soak gate rides the default sweep; the full
+    # compressed-week soak stays opt-in (--config soak)
+    soak_smoke = _subprocess_config("soak-smoke")
 
     # median run (by headline metric) is the reported one
     images = sorted(image_runs,
@@ -3072,6 +3232,7 @@ def main() -> None:
         "witness": witness,
         "router": router,
         "impact": impact,
+        "soak_smoke": soak_smoke,
     }))
 
 

@@ -649,3 +649,103 @@ class TestDegradedReports:
         assert len(degraded) == 1 and "img1.tar" in degraded[0]
         err = capsys.readouterr().err
         assert "degraded" in err
+
+
+class TestSpecComposition:
+    """The comma-composition grammar (ISSUE 17 satellite): a soak
+    step asks for storms + kills + hostile trickle *simultaneously*
+    by comma-combining scenario names. Sub-specs draw independently
+    derived sub-seeds; conflicting scalar assignments fail up front
+    naming the offending pair."""
+
+    def test_multi_segment_parse(self):
+        from trivy_tpu.faults.spec import parse_fault_specs
+        specs = parse_fault_specs(
+            "event-storm,replica-kill,hostile-ingest")
+        assert [s.scenario for s in specs] == \
+            ["event-storm", "replica-kill", "hostile-ingest"]
+        assert specs[0].storm_events == 256
+        assert specs[1].replica_kill_after == 32
+        assert specs[2].hostile == ("all",)
+
+    def test_params_bind_to_most_recent_segment(self):
+        from trivy_tpu.faults.spec import parse_fault_specs
+        specs = parse_fault_specs(
+            "event-storm:storm_events=64,storm_malformed=4,"
+            "replica-kill:replica_kill_after=8")
+        assert len(specs) == 2
+        assert specs[0].storm_events == 64
+        assert specs[0].storm_malformed == 4
+        assert specs[1].replica_kill_after == 8
+        # the kill sub-spec never saw the storm's overrides
+        assert specs[1].storm_events == 0
+
+    def test_derived_subseeds_independent_and_stable(self):
+        from trivy_tpu.faults.spec import (derive_subseed,
+                                           parse_fault_specs)
+        a = parse_fault_specs("event-storm,replica-kill")
+        b = parse_fault_specs("event-storm,replica-kill")
+        assert [s.seed for s in a] == [s.seed for s in b]
+        assert a[0].seed != a[1].seed
+        assert a[1].seed == derive_subseed(a[0].seed, 1,
+                                           "replica-kill")
+        # explicit seed= on a later segment wins over derivation
+        c = parse_fault_specs(
+            "event-storm,replica-kill:seed=99")
+        assert c[1].seed == 99
+
+    def test_base_seed_propagates_to_derivation(self):
+        from trivy_tpu.faults.spec import parse_fault_specs
+        a = parse_fault_specs("event-storm:seed=1,replica-kill")
+        b = parse_fault_specs("event-storm:seed=2,replica-kill")
+        assert a[1].seed != b[1].seed
+
+    def test_combine_merges_domains(self):
+        from trivy_tpu.faults.spec import parse_fault_spec
+        spec = parse_fault_spec(
+            "event-storm,replica-kill,cache-flaky")
+        assert spec.scenario == \
+            "event-storm+replica-kill+cache-flaky"
+        assert spec.wants_event_storm()
+        assert spec.wants_route_faults()
+        assert spec.wants_cache_faults()
+
+    def test_conflict_names_the_pair(self):
+        from trivy_tpu.faults.spec import parse_fault_spec
+        with pytest.raises(ValueError) as ei:
+            parse_fault_spec("cache-outage,cache-down")
+        msg = str(ei.value)
+        assert "cache-outage" in msg and "cache-down" in msg
+        assert "cache_fail_ops" in msg
+
+    def test_same_value_is_not_a_conflict(self):
+        from trivy_tpu.faults.spec import parse_fault_spec
+        spec = parse_fault_spec(
+            "cache-outage,standard-outage:cache_fail_ops=40")
+        assert spec.cache_fail_ops == 40
+
+    def test_tuple_fields_union_deduped(self):
+        from trivy_tpu.faults.spec import parse_fault_spec
+        spec = parse_fault_spec(
+            "poison-image:poison=a.tar;b.tar,"
+            "device-transient:poison=b.tar;c.tar")
+        assert spec.poison == ("a.tar", "b.tar", "c.tar")
+
+    def test_single_spec_back_compat(self):
+        from trivy_tpu.faults.spec import (FaultSpec,
+                                           parse_fault_specs)
+        specs = parse_fault_specs("cache-outage:seed=7")
+        assert len(specs) == 1 and specs[0].seed == 7
+        # bare k=v legacy grammar forms one anonymous sub-spec
+        specs = parse_fault_specs("cache_fail_ops=3,deadline_s=0.5")
+        assert len(specs) == 1
+        assert specs[0].cache_fail_ops == 3
+        assert specs[0].deadline_s == 0.5
+        # passthrough and empty
+        assert parse_fault_specs(FaultSpec(seed=5))[0].seed == 5
+        assert parse_fault_specs("")[0] == FaultSpec()
+
+    def test_unknown_scenario_still_fails_fast(self):
+        from trivy_tpu.faults.spec import parse_fault_specs
+        with pytest.raises(ValueError, match="unknown fault"):
+            parse_fault_specs("event-storm,not-a-scenario")

@@ -778,3 +778,134 @@ class TestWatchMetricsSurface:
         trace_id = next(iter(ex.values()))[0]
         assert trace_id and all(
             c in "0123456789abcdef" for c in trace_id)
+
+
+class TestCursorTornCheckpoint:
+    """Torn-file fuzz for the checkpoint loader (ISSUE 17 satellite):
+    whatever bytes land on disk — truncations, flipped bytes,
+    partial JSON, wrong types, a stale CRC — the Cursor must degrade
+    to replay-from-start (or load a genuinely valid position), never
+    raise, and NEVER resume past a position it cannot prove was
+    acked. Skipping unacked events is the one failure mode worse
+    than replay; dedupe and idempotency absorb the re-scans."""
+
+    @staticmethod
+    def _load(tmp_path, data: bytes):
+        from trivy_tpu.watch.source import Cursor
+        p = tmp_path / "cursor.json"
+        p.write_bytes(data)
+        return Cursor(str(p))
+
+    def test_valid_roundtrip(self, tmp_path):
+        from trivy_tpu.watch.source import Cursor
+        p = tmp_path / "cursor.json"
+        cur = Cursor(str(p))
+        for seq in range(5):
+            cur.ack(seq)
+        assert cur.position == 4
+        assert Cursor(str(p)).position == 4
+
+    def test_legacy_position_only_doc(self, tmp_path):
+        cur = self._load(tmp_path, b'{"position": 17}')
+        assert cur.position == 17
+
+    def test_torn_fuzz_never_crashes_never_skips(self, tmp_path):
+        import random
+        import zlib
+
+        def crc(pos):
+            return zlib.crc32(f"position:{pos}".encode())
+
+        rng = random.Random(20260807)
+        valid = json.dumps(
+            {"position": 1000, "crc": crc(1000)}).encode()
+        corpus = [
+            b"", b"{", b"null", b"[]", b'"position"', b"\x00\xff",
+            b'{"position": true}', b'{"position": "12"}',
+            b'{"position": 12.5}',
+            b'{"position": 12, "extra": 1}',
+            b'{"position": 12, "crc": 0}',
+            # flipped digit with a stale CRC: parses as valid JSON
+            # with a LARGER position — the CRC must reject it
+            json.dumps({"position": 9000,
+                        "crc": crc(1000)}).encode(),
+        ]
+        # seeded torn writes: every prefix class + random byte flips
+        for _ in range(200):
+            roll = rng.random()
+            if roll < 0.4:
+                corpus.append(valid[:rng.randrange(len(valid))])
+            elif roll < 0.8:
+                b = bytearray(valid)
+                for _ in range(1 + rng.randrange(3)):
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+                corpus.append(bytes(b))
+            else:
+                corpus.append(bytes(rng.randrange(256)
+                                    for _ in range(
+                                        rng.randrange(40))))
+        for data in corpus:
+            cur = self._load(tmp_path, data)  # must never raise
+            pos = cur.position
+            if pos != -1:
+                # anything other than full replay must be a
+                # provably-intact checkpoint: either the exact valid
+                # doc survived, or a legacy/CRC-consistent doc whose
+                # position the tag vouches for
+                doc = json.loads(data.decode("utf-8"))
+                assert doc["position"] == pos
+                if set(doc) != {"position"}:
+                    assert doc["crc"] == crc(pos)
+
+    def test_unreadable_checkpoint_degrades_to_replay(self, tmp_path):
+        cur = self._load(tmp_path, b'{"position": 12, "crc": 999}')
+        assert cur.position == -1
+        # and the cursor still functions: acks advance + persist
+        cur.ack(0)
+        assert cur.position == 0
+
+
+class TestCursorAckWindowCap:
+    """Bounded-growth regression (ISSUE 17 satellite): a hole the
+    stream never fills must not grow the out-of-order ack set
+    without bound. At the cap the cursor abandons the oldest hole,
+    advances, and counts the skip — the soak leak audit samples
+    ``stats()["ack_window"]`` to prove it stays flat."""
+
+    def test_window_bounded_and_hole_abandoned(self):
+        from trivy_tpu.watch.source import Cursor
+        cap = 64
+        cur = Cursor(ack_window=cap)
+        cur.ack(0)
+        # seq 2.. ack forever; seq 1 never does (a lost event)
+        for seq in range(2, 2 + cap + 1):
+            cur.ack(seq)
+            assert cur.stats()["ack_window"] <= cap
+        st = cur.stats()
+        assert st["abandoned"] == 1          # exactly the hole
+        assert st["position"] == 2 + cap     # jumped past it
+        assert st["ack_window"] == 0         # window drained
+
+    def test_floor_on_tiny_caps(self):
+        from trivy_tpu.watch.source import Cursor
+        cur = Cursor(ack_window=1)           # floors to 16
+        for seq in range(2, 19):             # holes at 0 AND 1
+            cur.ack(seq)
+        assert cur.stats()["ack_window"] <= 16
+        assert cur.stats()["abandoned"] == 2
+
+    def test_no_abandonment_when_window_suffices(self):
+        from trivy_tpu.watch.source import Cursor
+        import random
+        rng = random.Random(7)
+        cur = Cursor(ack_window=1024)
+        seqs = list(range(500))
+        rng.shuffle(seqs)
+        # arbitrary reordering, every seq eventually acked: under an
+        # ample window nothing is abandoned and the books close
+        for seq in seqs:
+            cur.ack(seq)
+        st = cur.stats()
+        assert st["position"] == 499
+        assert st["ack_window"] == 0
+        assert st["abandoned"] == 0

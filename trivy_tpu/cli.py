@@ -582,6 +582,34 @@ def build_parser() -> argparse.ArgumentParser:
                     "(replica-flaky response drops; "
                     "docs/robustness.md)")
 
+    soak = sub.add_parser(
+        "soak", help="run a registry-scale soak scenario against a "
+        "routed CPU-sim fleet: seeded synthetic registry, scripted "
+        "chaos on a compressed clock, fleet SLO + books + leak "
+        "verdicts (docs/robustness.md 'Soak & chaos testing')")
+    soak.add_argument("--scenario", default="soak-smoke",
+                      help="preset name (soak, soak-smoke) or a "
+                      "JSON ScenarioSpec file")
+    soak.add_argument("--replicas", type=int, default=3)
+    soak.add_argument("--seed", type=int, default=0,
+                      help="override the scenario seed (0 = keep)")
+    soak.add_argument("--duration", type=float, default=0.0,
+                      help="override virtual duration seconds")
+    soak.add_argument("--compression", type=float, default=0.0,
+                      help="override virtual-seconds-per-real-"
+                      "second")
+    soak.add_argument("--mode", default="inproc",
+                      choices=["inproc", "subprocess"],
+                      help="replica isolation: in-process sims or "
+                      "one OS process each")
+    soak.add_argument("--report", default="",
+                      help="write the full JSON report here "
+                      "(sort_keys; same-seed runs diff cleanly)")
+    soak.add_argument("--epoch", type=float, default=0.5,
+                      help="audit/verdict sampling period, real "
+                      "seconds")
+    soak.add_argument("--service-ms", type=float, default=3.0)
+
     plug = sub.add_parser("plugin", help="manage plugins")
     plugsub = plug.add_subparsers(dest="plugin_command")
     pi = plugsub.add_parser("install", help="install from a local "
@@ -629,7 +657,8 @@ def build_parser() -> argparse.ArgumentParser:
 _KNOWN_COMMANDS = ("image", "filesystem", "fs", "rootfs", "repo",
                    "sbom", "k8s", "aws", "db", "server", "route",
                    "watch", "plugin", "config", "conf", "module",
-                   "m", "client", "c", "impact", "version")
+                   "m", "client", "c", "impact", "soak",
+                   "version")
 
 
 def main(argv=None) -> int:
@@ -773,7 +802,43 @@ def _dispatch(args) -> int:
         return run_aws(args)
     if args.command == "impact":
         return run_impact(args)
+    if args.command == "soak":
+        return run_soak_cmd(args)
     return 2
+
+
+def run_soak_cmd(args) -> int:
+    """``trivy-tpu soak --scenario NAME|FILE``: one scenario, one
+    fleet, one verdict. Exit 0 iff books balance, designed trips
+    trip exactly, and the leak audit passes."""
+    from .soak import load_scenario, run_soak
+    scenario = load_scenario(args.scenario, seed=args.seed,
+                             duration_s=args.duration,
+                             compression=args.compression)
+    report = run_soak(scenario, replicas=args.replicas,
+                      mode=args.mode, report_path=args.report,
+                      epoch_s=args.epoch,
+                      service_ms=args.service_ms)
+    stable = report["stable"]
+    trip = report["slo"]["trip"]
+    print(f"scenario {stable['scenario']} seed {stable['seed']} "
+          f"({stable['arrivals']} arrivals, "
+          f"{stable['steps']} steps, "
+          f"{report['wall']['duration_s']}s wall)")
+    print(f"  books: lost={stable['lost']} "
+          f"balanced={stable['books_balanced']}")
+    print(f"  slo:   trips_exact={stable['trips_exact']} "
+          f"dumps={trip['dumps']}")
+    print(f"  leak:  audit_ok={stable['audit_ok']}")
+    sustained = report["throughput"]["sustained"]
+    if sustained["seconds"]:
+        print(f"  ips:   {sustained['ips']} sustained over "
+              f"{sustained['seconds']}s steady state")
+    if args.report:
+        print(f"  report: {args.report}")
+    ok = (stable["books_balanced"] and stable["trips_exact"]
+          and stable["audit_ok"])
+    return 0 if ok else 1
 
 
 def run_impact(args) -> int:

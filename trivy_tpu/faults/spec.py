@@ -15,9 +15,22 @@ Spec strings::
     cache-outage:seed=7,cache_fail_ops=80
     poison-image:poison=img7.tar
     poison=img3.tar;img9.tar,device_fail_batches=1   # bare overrides
+    event-storm,replica-kill,hostile-ingest          # composition
+
+Composition (the last form) is how a soak script asks for storms +
+kills + hostile trickle *simultaneously*: each comma-separated
+scenario name opens a new sub-spec (``k=v`` items bind to the
+sub-spec opened most recently), every sub-spec after the first draws
+an independently derived sub-seed so co-injected domains don't
+replay each other's random streams, and
+:func:`combine_fault_specs` merges them — two sub-specs assigning
+*different* values to the same scalar field fail up front with the
+offending pair named.
 """
 
 from __future__ import annotations
+
+import hashlib
 
 from dataclasses import dataclass, fields, replace
 
@@ -173,21 +186,19 @@ def _coerce(name: str, raw: str):
     return raw
 
 
-def parse_fault_spec(text) -> FaultSpec:
-    """``"scenario[:k=v,...]"`` or bare ``"k=v,..."`` → FaultSpec.
+def derive_subseed(base_seed: int, index: int, name: str) -> int:
+    """Deterministic per-sub-spec seed for composed scenarios: a
+    stable hash of ``(base seed, position, scenario name)`` so
+    ``event-storm,replica-kill`` gives the storm and the kill
+    independent random streams that never collide — and the same
+    composed string always derives the same pair."""
+    h = hashlib.sha256(
+        f"{base_seed}:{index}:{name}".encode()).hexdigest()
+    return int(h[:12], 16)
 
-    Unknown scenario names and unknown keys raise ValueError so a
-    typo'd --fault-spec fails the run up front instead of silently
-    injecting nothing.
-    """
-    if isinstance(text, FaultSpec):
-        return text
-    text = (text or "").strip()
-    if not text:
-        return FaultSpec()
-    name, sep, rest = text.partition(":")
-    if not sep and "=" in name:
-        name, rest = "", text
+
+def _parse_segment(name: str, pairs: list) -> tuple:
+    """One sub-spec: ``(overrides dict, explicit_seed bool)``."""
     overrides: dict = {}
     if name:
         preset = SCENARIOS.get(name)
@@ -197,10 +208,8 @@ def parse_fault_spec(text) -> FaultSpec:
                 f"(choose from {', '.join(sorted(SCENARIOS))})")
         overrides.update(preset)
         overrides["scenario"] = name
-    for pair in rest.split(","):
-        pair = pair.strip()
-        if not pair:
-            continue
+    explicit_seed = False
+    for pair in pairs:
         key, eq, raw = pair.partition("=")
         key = key.strip()
         if not eq or key not in _FIELDS:
@@ -212,4 +221,112 @@ def parse_fault_spec(text) -> FaultSpec:
         except (TypeError, ValueError):
             raise ValueError(
                 f"bad fault-spec value for {key!r}: {raw!r}")
-    return replace(FaultSpec(), **overrides)
+        if key == "seed":
+            explicit_seed = True
+    return overrides, explicit_seed
+
+
+def parse_fault_specs(text) -> tuple:
+    """``"scenario[:k=v,...][,scenario2[:...]]..."`` → tuple of
+    :class:`FaultSpec`, one per comma-combined scenario.
+
+    Each scenario name opens a new sub-spec; bare ``k=v`` items bind
+    to the most recently opened one (a leading run of ``k=v`` items
+    forms an anonymous sub-spec, the legacy single-spec grammar).
+    Sub-specs after the first that don't say ``seed=`` explicitly
+    get :func:`derive_subseed`'d seeds, so composed domains draw
+    from independent random streams deterministically."""
+    if isinstance(text, FaultSpec):
+        return (text,)
+    text = (text or "").strip()
+    if not text:
+        return (FaultSpec(),)
+    # split into segments: each item is either "name", "name:k=v",
+    # or "k=v"; a name (no "=" before any ":") opens a new segment
+    segments: list = []       # (name, [pairs])
+    for item in text.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        head, sep, rest = item.partition(":")
+        if "=" not in head:
+            segments.append([head, []])
+            if sep and rest.strip():
+                segments[-1][1].append(rest.strip())
+        else:
+            if not segments:
+                segments.append(["", []])
+            segments[-1][1].append(item)
+    specs: list = []
+    base_seed = FaultSpec.seed
+    for i, (name, pairs) in enumerate(segments):
+        overrides, explicit_seed = _parse_segment(name, pairs)
+        if i == 0:
+            base_seed = overrides.get("seed", base_seed)
+        elif not explicit_seed:
+            overrides["seed"] = derive_subseed(base_seed, i, name)
+        specs.append(replace(FaultSpec(), **overrides))
+    return tuple(specs)
+
+
+_DEFAULT = FaultSpec()
+_TUPLE_FIELDS = tuple(f.name for f in fields(FaultSpec)
+                      if f.type in ("tuple", tuple))
+
+
+def combine_fault_specs(specs) -> FaultSpec:
+    """Merge composed sub-specs into the one :class:`FaultSpec` the
+    injector consumes. Tuple fields union (order-preserving, deduped
+    — co-injecting two poison lists means both poison); scalar
+    fields conflict-checked: two sub-specs assigning *different*
+    non-default values to the same field raise ValueError naming the
+    offending pair up front, instead of one scenario silently
+    clobbering the other mid-run. The merged seed is the first
+    sub-spec's; per-domain randomness should use the sub-spec seeds
+    (:func:`parse_fault_specs` derives them)."""
+    specs = [s for s in specs if s is not None]
+    if not specs:
+        return FaultSpec()
+    if len(specs) == 1:
+        return specs[0]
+    merged: dict = {}
+    owner: dict = {}
+    names = [s.scenario or f"<spec#{i}>"
+             for i, s in enumerate(specs)]
+    for i, spec in enumerate(specs):
+        for f in fields(FaultSpec):
+            if f.name in ("scenario", "seed"):
+                continue
+            val = getattr(spec, f.name)
+            if val == getattr(_DEFAULT, f.name):
+                continue
+            if f.name not in merged:
+                merged[f.name] = val
+                owner[f.name] = i
+                continue
+            if f.name in _TUPLE_FIELDS:
+                seen = merged[f.name]
+                merged[f.name] = seen + tuple(
+                    v for v in val if v not in seen)
+            elif merged[f.name] != val:
+                raise ValueError(
+                    f"conflicting fault-spec composition: "
+                    f"{names[owner[f.name]]} and {names[i]} both "
+                    f"set {f.name} "
+                    f"({merged[f.name]!r} vs {val!r})")
+    merged["scenario"] = "+".join(n for n in
+                                  (s.scenario for s in specs) if n)
+    merged["seed"] = specs[0].seed
+    return replace(FaultSpec(), **merged)
+
+
+def parse_fault_spec(text) -> FaultSpec:
+    """``"scenario[:k=v,...]"`` or bare ``"k=v,..."`` → FaultSpec.
+    Comma-combined scenarios parse as a composition and merge via
+    :func:`combine_fault_specs`.
+
+    Unknown scenario names and unknown keys raise ValueError so a
+    typo'd --fault-spec fails the run up front instead of silently
+    injecting nothing.
+    """
+    return combine_fault_specs(parse_fault_specs(text))

@@ -115,6 +115,27 @@ def _histograms(w: _Writer, name: str, label: str, hists: dict,
         w.sample(full + "_count", [(label, key)], h["count"])
 
 
+def _process_gauges(w: _Writer, proc: dict) -> None:
+    """Process self-stat gauges (obs/procstats.py) — shared by the
+    replica and router renderers so the soak leak audit reads the
+    same family names off every process in the fleet. ``-1`` samples
+    (gauge unavailable on this platform) are skipped, not rendered:
+    absence is the documented "no data" signal."""
+    if not proc:
+        return
+    for key, name, help_ in (
+            ("rss_bytes", "rss_bytes",
+             "Resident set size of this process (VmRSS)."),
+            ("open_fds", "open_fds",
+             "Open file descriptors of this process."),
+            ("threads", "threads",
+             "Live interpreter threads in this process.")):
+        v = proc.get(key)
+        if v is None or (isinstance(v, int) and v < 0):
+            continue
+        w.scalar(f"{_PREFIX}_process_{name}", "gauge", help_, v)
+
+
 def render_prometheus(stats: dict, phase_hists=None,
                       trace_hists=None, tenant_hists=None,
                       tracer_stats=None,
@@ -614,6 +635,8 @@ def render_prometheus(stats: dict, phase_hists=None,
                 "K8s admission review latency (wall time of "
                 "POST /k8s/admission).", openmetrics)
 
+    _process_gauges(w, stats.get("process") or {})
+
     if openmetrics:
         w.lines.append("# EOF")
     return "\n".join(w.lines) + "\n"
@@ -702,4 +725,5 @@ def render_router(stats: dict, hists=None) -> str:
                 "time, upstream_latency = time waiting on the "
                 "upstream replica; the difference is attributed "
                 "router overhead.")
+    _process_gauges(w, stats.get("process") or {})
     return "\n".join(w.lines) + "\n"

@@ -54,9 +54,11 @@ class RouterServer:
                 "routable": len(routable)}
 
     def metrics(self) -> dict:
+        from ..obs.procstats import process_self_stats
         out = self.router.stats()
         if self.scaler is not None:
             out["scaler"] = self.scaler.stats()
+        out["process"] = process_self_stats()
         return out
 
     def metrics_text(self) -> str:

@@ -124,6 +124,14 @@ class SimReplicaController(ReplicaController):
         if sim is not None:
             sim.stop()
 
+    def kill(self, name: str) -> None:
+        """Abrupt death, no drain: the in-process analogue of the
+        subprocess controller's SIGKILL lever (soak replica-kill
+        steps). In-flight requests error at the router and replay."""
+        sim = self.replicas.pop(name, None)
+        if sim is not None:
+            sim.kill()
+
 
 class SubprocessReplicaController(ReplicaController):
     """One OS process per replica via ``python -m

@@ -18,14 +18,23 @@ that matter for fleet behavior:
 * seeded faults: ``kill_after=N`` hard-exits the process mid-request
   after N scans (replica death mid-storm), ``flaky_every=N`` does
   the work then drops every Nth response (the lost-response hazard
-  idempotent replay neutralizes).
+  idempotent replay neutralizes);
+* runtime chaos (``POST /chaos``): the soak harness steers error
+  windows (brownouts), response-drop windows, service-time changes
+  and rolling DB hot swaps (``db_generation`` bump → warm state
+  cold, like a memo ctx_sig change) on a *live* replica mid-run;
+* a per-replica SLO engine + ``GET /metrics/snapshot``, so the
+  PR-13 federation plane (obs/federate.py) renders genuine fleet
+  burn-rate verdicts over a sim fleet.
 
 IMPORTANT: keep this module importable with stdlib only (no jax, no
 trivy_tpu heavyweight imports) — ``python -m trivy_tpu.router.sim``
 is the subprocess replica the SubprocessReplicaController and the
 bench spawn, and its startup cost is fleet-bringup cost. The twirp
 path constants are restated here (protocol literals, same values as
-``rpc/server.py``) for exactly that reason.
+``rpc/server.py``) for exactly that reason. The obs imports below
+are lazy and land in ``trivy_tpu.obs.slo``/``procstats`` — both
+stdlib-only by charter (obs/__init__.py).
 """
 
 from __future__ import annotations
@@ -54,7 +63,9 @@ class SimReplica:
                  max_concurrent: int = 2,
                  kill_after: int = 0,
                  flaky_every: int = 0,
-                 tenant_rate: float = 0.0):
+                 tenant_rate: float = 0.0,
+                 seed: int = 20260804,
+                 slo_availability: float = 0.99):
         self.name = name
         self.addr = addr
         self._port = port
@@ -75,7 +86,30 @@ class SimReplica:
         self.inflight = 0
         self.counters = {"scans": 0, "memo_hits": 0, "deduped": 0,
                          "dropped": 0, "rate_limited": 0,
-                         "cache_ops": 0, "drained_rejects": 0}
+                         "cache_ops": 0, "drained_rejects": 0,
+                         "chaos_errors": 0, "chaos_drops": 0,
+                         "db_swaps": 0, "hostile_quarantined": 0,
+                         "cache_op_errors": 0}
+        # runtime chaos knobs, steered via POST /chaos mid-run
+        import random
+        self._chaos_rng = random.Random(seed)
+        self.error_rate = 0.0       # answer 500 internal (brownout)
+        self.drop_rate = 0.0        # do the work, drop the response
+        self.cache_error_rate = 0.0  # cache-tier ops answer 500
+        self.db_generation = 0      # memo/advisory-DB generation
+        # per-replica SLO engine: availability burn over this sim's
+        # own outcomes, exported age-keyed for PR-13 federation
+        # (lazy import: trivy_tpu.obs.slo is stdlib-only). The
+        # objective is a knob because compressed soak runs need a
+        # tighter target for a scripted brownout to trip decisively
+        # inside one burn window.
+        from ..obs.slo import SLO, SloEngine, default_slos
+        slos = default_slos()
+        if slo_availability != 0.99:
+            slos = [SLO(name="availability", kind="availability",
+                        objective=slo_availability)] + \
+                   [s for s in slos if s.kind != "availability"]
+        self.slo = SloEngine(slos)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -106,6 +140,46 @@ class SimReplica:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+
+    def kill(self) -> None:
+        """Abrupt in-process death: close the listening socket with
+        no drain — in-flight requests error out at the router, which
+        must replay them elsewhere (the soak kill step for fleets
+        too large to spawn as subprocesses)."""
+        self.stop()
+
+    def chaos(self, body: dict) -> dict:
+        """``POST /chaos`` — runtime-steerable failure knobs. Absent
+        keys leave the knob alone; returns the full current state so
+        the harness can read-modify-write."""
+        with self._lock:
+            if "error_rate" in body:
+                self.error_rate = max(
+                    0.0, min(1.0, float(body["error_rate"])))
+            if "drop_rate" in body:
+                self.drop_rate = max(
+                    0.0, min(1.0, float(body["drop_rate"])))
+            if "cache_error_rate" in body:
+                self.cache_error_rate = max(
+                    0.0, min(1.0, float(body["cache_error_rate"])))
+            if "service_ms" in body:
+                self.service_ms = max(0.0,
+                                      float(body["service_ms"]))
+            if "db_generation" in body:
+                gen = int(body["db_generation"])
+                if gen != self.db_generation:
+                    # hot swap: a new advisory-DB generation strands
+                    # the warm state, exactly like a memo ctx_sig
+                    # change — the next scan of a known digest is
+                    # cold again
+                    self.db_generation = gen
+                    self._warm.clear()
+                    self.counters["db_swaps"] += 1
+            return {"error_rate": self.error_rate,
+                    "drop_rate": self.drop_rate,
+                    "cache_error_rate": self.cache_error_rate,
+                    "service_ms": self.service_ms,
+                    "db_generation": self.db_generation}
 
     def warm_digests(self) -> set:
         with self._lock:
@@ -154,8 +228,20 @@ class SimReplica:
             if cached is not None:
                 self._inc("deduped")
                 return 200, dict(cached, deduped=True), False
+        with self._lock:
+            chaos_err = (self.error_rate > 0
+                         and self._chaos_rng.random()
+                         < self.error_rate)
+        if chaos_err:
+            # brownout window: a genuine 500 — terminal `failed` at
+            # the router, a bad event on this replica's SLO books
+            self._inc("chaos_errors")
+            self.slo.record("failed")
+            return 500, {"code": "internal",
+                         "msg": "sim chaos error window"}, False
         blob_ids = [str(b) for b in body.get("blob_ids") or []]
         base = blob_ids[0] if blob_ids else ""
+        t0 = time.monotonic()
         with self._lock:
             self.inflight += 1
             hit = base in self._warm if base else False
@@ -180,7 +266,17 @@ class SimReplica:
             payload = {"os": {"family": "sim", "name": "0"},
                        "results": [],
                        "memo_hit": hit,
+                       "db_generation": self.db_generation,
                        "replica": self.name}
+            if body.get("hostile"):
+                # hostile-artifact trickle: the guard layer's
+                # contract is quarantine-and-degrade, never crash —
+                # a 200 with the degraded verdict, like the real
+                # server's per-target FailureCause path
+                payload["degraded"] = True
+                payload["quarantined"] = [str(body.get("target")
+                                              or "")]
+                self._inc("hostile_quarantined")
             if key:
                 with self._lock:
                     self._idem[key] = payload
@@ -188,15 +284,33 @@ class SimReplica:
                         self._idem.popitem(last=False)
             drop = bool(self.flaky_every
                         and n % self.flaky_every == 0)
+            if not drop and self.drop_rate > 0:
+                with self._lock:
+                    drop = self._chaos_rng.random() < self.drop_rate
+                if drop:
+                    self._inc("chaos_drops")
             if drop:
                 self._inc("dropped")
+            # the work completed, whoever hears about it — a dropped
+            # response is still a good event on this replica's books
+            self.slo.record("ok", time.monotonic() - t0)
             return 200, payload, drop
         finally:
             with self._lock:
                 self.inflight -= 1
 
-    def cache_op(self, path: str, body: dict) -> dict:
+    def cache_op(self, path: str, body: dict):
         self._inc("cache_ops")
+        with self._lock:
+            outage = (self.cache_error_rate > 0
+                      and self._chaos_rng.random()
+                      < self.cache_error_rate)
+        if outage:
+            # cache-tier outage window: a genuine 500 the resilient
+            # cache layer circuit-breaks around in a real server —
+            # terminal `failed` at the router, NOT an SLO-bad scan
+            self._inc("cache_op_errors")
+            return None
         op = path[len(CACHE_PREFIX):]
         with self._lock:
             if op == "PutBlob":
@@ -223,13 +337,60 @@ class SimReplica:
                 "build": {"replica": self.name, "sim": True}}
 
     def metrics(self) -> dict:
+        from ..obs.procstats import process_self_stats
         with self._lock:
             out = dict(self.counters)
             out["warm_digests"] = len(self._warm)
+            out["idempotency_entries"] = len(self._idem)
+            out["tenant_buckets"] = len(self._buckets)
             out["inflight"] = self.inflight
+            out["db_generation"] = self.db_generation
         out["draining"] = self.draining
         out["name"] = self.name
+        out["process"] = process_self_stats()
+        out["slo"] = self.slo.snapshot()
         return out
+
+    def build_info(self) -> dict:
+        return {"version": "sim", "jax_version": "",
+                "backend": "sim", "sched": "sim"}
+
+    def metrics_text(self) -> str:
+        """Minimal but valid 0.0.4 exposition — enough families for
+        the federation plane's merged view (counters + the process
+        self-stats the soak leak audit reads off every process)."""
+        m = self.metrics()
+        lines = []
+        lines.append("# HELP trivy_tpu_sim_events_total Simulated "
+                     "replica lifecycle events by kind.")
+        lines.append("# TYPE trivy_tpu_sim_events_total counter")
+        for k in sorted(self.counters):
+            lines.append(
+                f'trivy_tpu_sim_events_total{{event="{k}"}} '
+                f"{m.get(k, 0)}")
+        proc = m.get("process") or {}
+        for key, fam in (("rss_bytes",
+                          "trivy_tpu_process_rss_bytes"),
+                         ("open_fds", "trivy_tpu_process_open_fds"),
+                         ("threads", "trivy_tpu_process_threads")):
+            v = proc.get(key)
+            if v is None or (isinstance(v, int) and v < 0):
+                continue
+            lines.append(f"# HELP {fam} Process self-stat gauge.")
+            lines.append(f"# TYPE {fam} gauge")
+            lines.append(f"{fam} {v}")
+        return "\n".join(lines) + "\n"
+
+    def metrics_snapshot(self) -> dict:
+        """``GET /metrics/snapshot`` — the federation pull contract
+        (same shape as ``rpc/server.py metrics_snapshot``): name,
+        build identity, prom text, the age-keyed SLO export, and the
+        replica's monotonic now for staleness checks."""
+        return {"name": self.name,
+                "build_info": self.build_info(),
+                "prom": self.metrics_text(),
+                "slo_export": self.slo.export_state(),
+                "mono": time.monotonic()}
 
 
 def _make_handler(sim: SimReplica):
@@ -255,6 +416,8 @@ def _make_handler(sim: SimReplica):
                 self._reply(200, sim.health())
             elif self.path == "/metrics":
                 self._reply(200, sim.metrics())
+            elif self.path == "/metrics/snapshot":
+                self._reply(200, sim.metrics_snapshot())
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
@@ -263,6 +426,21 @@ def _make_handler(sim: SimReplica):
             if self.path == "/drain":
                 sim.drain()
                 self._reply(200, {"draining": True})
+                return
+            if self.path == "/chaos":
+                try:
+                    length = int(self.headers.get("Content-Length")
+                                 or 0)
+                    body = json.loads(self.rfile.read(length)
+                                      or b"{}")
+                except ValueError:
+                    body = None
+                if not isinstance(body, dict):
+                    self._reply(400, {"code": "malformed",
+                                      "msg": "chaos wants a JSON "
+                                             "object"})
+                    return
+                self._reply(200, sim.chaos(body))
                 return
             try:
                 length = int(self.headers.get("Content-Length")
@@ -295,7 +473,12 @@ def _make_handler(sim: SimReplica):
                     self._reply(503, {"code": "unavailable",
                                       "msg": "sim draining"})
                     return
-                self._reply(200, sim.cache_op(self.path, body))
+                res = sim.cache_op(self.path, body)
+                if res is None:
+                    self._reply(500, {"code": "internal",
+                                      "msg": "sim cache outage"})
+                    return
+                self._reply(200, res)
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
@@ -319,13 +502,17 @@ def main(argv=None) -> int:
     p.add_argument("--kill-after", type=int, default=0)
     p.add_argument("--flaky-every", type=int, default=0)
     p.add_argument("--tenant-rate", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=20260804)
+    p.add_argument("--slo-availability", type=float, default=0.99)
     args = p.parse_args(argv)
     sim = SimReplica(name=args.name, port=args.port,
                      addr=args.addr, service_ms=args.service_ms,
                      max_concurrent=args.max_concurrent,
                      kill_after=args.kill_after,
                      flaky_every=args.flaky_every,
-                     tenant_rate=args.tenant_rate).start()
+                     tenant_rate=args.tenant_rate,
+                     seed=args.seed,
+                     slo_availability=args.slo_availability).start()
     print(f"PORT {sim.port}", flush=True)
     try:
         while True:

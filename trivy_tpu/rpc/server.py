@@ -531,6 +531,8 @@ class ScanServer:
             else self.scheduler.stats()
         out["draining"] = self._draining
         out["idempotency"] = self._idem.stats()
+        from ..obs.procstats import process_self_stats
+        out["process"] = process_self_stats()
         if "dispatch" not in out:
             # scheduler-off servers still report the dispatch-ring
             # books (slot depth/occupancy/overlap — the async slot

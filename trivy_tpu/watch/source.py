@@ -379,22 +379,46 @@ def make_event_storm(spec, paths: list) -> list:
     return out
 
 
+def _checkpoint_crc(position: int) -> int:
+    """Integrity tag for the checkpoint doc. A torn write or a
+    flipped byte in ``position`` can still parse as valid JSON with
+    a LARGER int — and a cursor that believes it would *skip unacked
+    events* on resume, the one failure mode worse than replay."""
+    import zlib
+    return zlib.crc32(f"position:{int(position)}".encode())
+
+
+# out-of-order ack window: seqs acked above a hole the stream never
+# fills (e.g. an event lost without a drop record). Past the cap the
+# oldest hole is declared abandoned and the cursor advances — a
+# bounded replay-on-restart beats an unbounded set (the soak leak
+# audit samples this window).
+ACK_WINDOW_CAP = 65536
+
+
 class Cursor:
     """Checkpointed stream position: ``ack(seq)`` as events resolve,
     ``position`` is the highest seq with every seq at or below it
     acked — a restart resumes AFTER it, never re-scanning work that
     already completed. Persistence is atomic (tmp + rename), like
-    every other on-disk artifact in this tree."""
+    every other on-disk artifact in this tree, and the doc carries a
+    CRC so a torn or bit-flipped checkpoint degrades to replay
+    instead of crashing the loop or (worse) skipping unacked
+    events."""
 
-    def __init__(self, path: str = ""):
+    def __init__(self, path: str = "",
+                 ack_window: int = ACK_WINDOW_CAP):
         self.path = path
         self._lock = threading.Lock()
         self._pos = -1
         self._acked: set = set()
+        self._ack_window = max(16, int(ack_window))
+        self.abandoned = 0       # holes declared lost at the cap
         if path and os.path.exists(path):
             try:
                 with open(path, encoding="utf-8") as f:
-                    self._pos = int(json.load(f).get("position", -1))
+                    doc = json.load(f)
+                self._pos = self._validate(doc)
             except (OSError, ValueError, TypeError) as e:
                 # a torn checkpoint must degrade to "replay from the
                 # start" — correctness is dedupe's job, the cursor
@@ -402,10 +426,38 @@ class Cursor:
                 log.warning("unreadable watch checkpoint %s: %r",
                             path, e)
 
+    @staticmethod
+    def _validate(doc) -> int:
+        """Checkpoint doc → position, raising ValueError on anything
+        suspect. Accepts the legacy ``{"position": N}`` shape (no
+        CRC, exactly one key); any other shape must carry a matching
+        ``crc`` — unknown keys or a stale/flipped tag mean the file
+        was damaged in a way JSON parsing can't see."""
+        if not isinstance(doc, dict):
+            raise ValueError(f"checkpoint is {type(doc).__name__}, "
+                             "not an object")
+        pos = doc.get("position", -1)
+        if isinstance(pos, bool) or not isinstance(pos, int):
+            raise ValueError(f"bad checkpoint position {pos!r}")
+        if set(doc) == {"position"}:
+            return pos           # legacy, pre-CRC checkpoint
+        if set(doc) != {"position", "crc"} or \
+                doc["crc"] != _checkpoint_crc(pos):
+            raise ValueError("checkpoint integrity check failed")
+        return pos
+
     @property
     def position(self) -> int:
         with self._lock:
             return self._pos
+
+    def stats(self) -> dict:
+        """Leak-audit surface: the out-of-order window size is the
+        one thing here that can grow."""
+        with self._lock:
+            return {"position": self._pos,
+                    "ack_window": len(self._acked),
+                    "abandoned": self.abandoned}
 
     def ack(self, seq: int) -> None:
         with self._lock:
@@ -417,6 +469,23 @@ class Cursor:
                 self._pos += 1
                 self._acked.discard(self._pos)
                 advanced = True
+            if len(self._acked) > self._ack_window:
+                # a hole nothing will ever fill: advance past it to
+                # the oldest acked seq (bounded memory; the skipped
+                # range replays on restart, which is safe — dedupe
+                # and idempotency absorb re-scans)
+                jump = min(self._acked)
+                log.warning(
+                    "watch cursor abandoning hole %d..%d "
+                    "(ack window %d over cap)", self._pos + 1,
+                    jump - 1, len(self._acked))
+                self.abandoned += jump - self._pos - 1
+                self._pos = jump
+                self._acked.discard(jump)
+                while self._pos + 1 in self._acked:
+                    self._pos += 1
+                    self._acked.discard(self._pos)
+                advanced = True
         if advanced:
             self.save()
 
@@ -424,7 +493,8 @@ class Cursor:
         if not self.path:
             return
         with self._lock:
-            doc = {"position": self._pos}
+            doc = {"position": self._pos,
+                   "crc": _checkpoint_crc(self._pos)}
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
