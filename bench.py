@@ -2489,7 +2489,7 @@ def bench_fleet_obs() -> dict:
 
 def bench_router() -> dict:
     """Fault-tolerant scan-router bench (docs/serving.md "Scan
-    router & autoscaling"). Four gated arms:
+    router & autoscaling", "Elastic lifecycle"). Six gated arms:
 
     * **parity** — findings through the router front byte-identical
       to a direct replica scan (real ScanServers);
@@ -2502,9 +2502,20 @@ def bench_router() -> dict:
       mid-storm at the replica-kill scenario's seeded instant:
       every request still terminates 200 and the router books
       balance (zero loss);
-    * **reshard** — after retiring one of four replicas, a re-scan
-      of the warmed digest set still serves >= 55% warm memo hits:
-      consistent hashing kept the surviving shards' memo warm.
+    * **reshard** — one of four replicas retires the real way
+      (drain + hot-digest handoff to its ring successors): a
+      re-scan of the warmed digest set serves >= 90% warm memo
+      hits with zero handoff digests abandoned — the working set
+      moved with the keys;
+    * **scale_up** — a replica joins mid-warm-fleet through the
+      elastic lifecycle (ring membership while ``warming``,
+      pre-join prewarm out of the shared memo tier, admission on
+      the prober's ready flip): admitted within one probe interval
+      of ready, and its first-request p99 stays <= 2x the warm
+      fleet's p99 — the join is not an availability event;
+    * **cold_join** — the same join against a broken memo tier:
+      the prewarm degrades to a cold join bounded by its deadline
+      (books ``prewarm_cold_joins``), never a wedged scale-up.
     """
     import hashlib
     import threading
@@ -2700,15 +2711,35 @@ def bench_router() -> dict:
         for name in list(ctrl.procs):
             ctrl.stop(name)
 
-    # ------- arm 4: reshard keeps survivor shards memo-warm ------
+    # ------- arm 4: drain handoff keeps the fleet memo-warm ------
+    import os
+    import tempfile
+
+    from trivy_tpu.router.lifecycle import run_handoff
     ROUTER_METRICS.reset()
-    sims = [SimReplica(name=f"w{i}", service_ms=0.0).start()
+    memo_dir = tempfile.mkdtemp(prefix="bench-memo-")
+    sims = [SimReplica(name=f"w{i}", service_ms=0.0,
+                       memo_dir=memo_dir).start()
             for i in range(4)]
     try:
         router = ScanRouter([(s.name, s.url) for s in sims])
         keys = digests(200, "warm")
         statuses, _ = storm(router, keys, 8)
         assert sorted(set(statuses)) == [200]
+        # converge warmth onto the pure ring owners: the storm's
+        # bounded-load spill warms neighbours too, and a sequential
+        # pass routes every key to its unloaded owner
+        for d in keys:
+            status, _, _ = router.route(SCAN_PATH, scan_raw(d))
+            assert status == 200
+        # retire w3 the real way: mark draining, hand its hot-digest
+        # set to the ring successors, THEN reshard — the working set
+        # moves with the keys instead of going cold
+        router.mark_draining("w3")
+        ho = run_handoff(router, "w3")
+        assert ho["published"] > 0, ho
+        assert ho["abandoned"] == 0, \
+            f"drain handoff abandoned digests: {ho}"
         router.remove_replica("w3")
         hits = 0
         for d in keys:
@@ -2717,12 +2748,138 @@ def bench_router() -> dict:
             hits += 1 if json.loads(body)["memo_hit"] else 0
         rate = hits / len(keys)
         out["post_reshard_warm_hit_rate"] = round(rate, 4)
-        assert rate >= 0.55, \
-            f"post-reshard warm hit rate {rate:.2%} < 55%"
+        out["handoff_published"] = ho["published"]
+        out["handoff_prefetched"] = ho["prefetched"]
+        assert rate >= 0.9, \
+            f"post-reshard warm hit rate {rate:.2%} < 90%"
         assert ROUTER_METRICS.snapshot()["lost"] == 0
     finally:
         for s in sims:
             s.stop()
+
+    # ------- arm 5: scale-up joins warm through the lifecycle -----
+    from trivy_tpu.router.core import HealthProber
+    ROUTER_METRICS.reset()
+    PROBE_S = 0.1
+    SERVICE_MS = 25.0
+    memo_dir = tempfile.mkdtemp(prefix="bench-memo-up-")
+    sims = [SimReplica(name=f"s{i}", service_ms=SERVICE_MS,
+                       max_concurrent=8, memo_dir=memo_dir).start()
+            for i in range(3)]
+    joiner = None
+    prober = None
+
+    def p99(samples):
+        ordered = sorted(samples)
+        return ordered[int(0.99 * (len(ordered) - 1))]
+
+    try:
+        router = ScanRouter([(s.name, s.url) for s in sims])
+        keys = digests(240, "up")
+        statuses, _ = storm(router, keys, 8)
+        assert sorted(set(statuses)) == [200]
+        # warm-fleet latency baseline (memo hits skip the simulated
+        # analyze work, exactly like the real findings memo)
+        fleet_lat = []
+        for d in keys[::3]:
+            t0 = time.perf_counter()
+            status, _, _ = router.route(SCAN_PATH, scan_raw(d))
+            fleet_lat.append(time.perf_counter() - t0)
+            assert status == 200
+        fleet_p99 = p99(fleet_lat)
+        # join s3 the real way: it enters the ring WARMING (one
+        # reshard, no admission), prewarms its post-join key ranges
+        # out of the shared memo tier, and the prober admits it on
+        # the ready flip
+        joiner = SimReplica(
+            name="s3", service_ms=SERVICE_MS, max_concurrent=8,
+            memo_dir=memo_dir,
+            ring_members=[s.name for s in sims]).start()
+        prober = HealthProber(router, interval_s=PROBE_S,
+                              timeout_s=1.0)
+        t_add = time.perf_counter()
+        router.add_replica("s3", joiner.url, warming=True)
+        prober.start()
+        handle = router.replica("s3")
+        while handle.warming:
+            assert time.perf_counter() - t_add < 10.0, \
+                "scale-up wedged in the warming state"
+            time.sleep(0.005)
+        admit_s = time.perf_counter() - t_add
+        # admitted within one probe interval of the replica's ready
+        # flip (margin: the probe that was in flight at flip time)
+        assert admit_s <= joiner.prewarm_seconds + 2 * PROBE_S \
+            + 0.5, \
+            (f"warming admission took {admit_s:.2f}s "
+             f"(prewarm {joiner.prewarm_seconds:.2f}s, "
+             f"probe {PROBE_S}s)")
+        assert joiner.counters["prewarm_keys"] > 0, joiner.counters
+        assert joiner.counters["prewarm_cold_joins"] == 0, \
+            joiner.counters
+        # first-request latency ON the joiner: every digest it now
+        # owns arrives for the first time post-join; prewarm means
+        # those are memo hits, not cold faults
+        joiner_lat = []
+        for d in keys:
+            t0 = time.perf_counter()
+            status, body, _ = router.route(SCAN_PATH, scan_raw(d))
+            lat = time.perf_counter() - t0
+            assert status == 200
+            if json.loads(body).get("replica") == "s3":
+                joiner_lat.append(lat)
+        assert joiner_lat, "ring assigned the joiner no keys"
+        joiner_p99 = p99(joiner_lat)
+        out["scale_up_admit_s"] = round(admit_s, 4)
+        out["scale_up_prewarm_keys"] = \
+            joiner.counters["prewarm_keys"]
+        out["scale_up_first_req_p99_ms"] = \
+            round(joiner_p99 * 1e3, 2)
+        out["scale_up_fleet_p99_ms"] = round(fleet_p99 * 1e3, 2)
+        assert joiner_p99 <= 2 * fleet_p99, \
+            (f"new-replica first-request p99 "
+             f"{joiner_p99 * 1e3:.1f}ms > 2x fleet p99 "
+             f"{fleet_p99 * 1e3:.1f}ms — the join went cold")
+        snap = ROUTER_METRICS.snapshot()
+        assert snap["lost"] == 0, snap
+    finally:
+        if prober is not None:
+            prober.stop()
+        if joiner is not None:
+            joiner.stop()
+        for s in sims:
+            s.stop()
+
+    # ------- arm 6: memo outage -> bounded cold join, not a wedge --
+    ROUTER_METRICS.reset()
+    broken_tier = os.path.join(
+        tempfile.mkdtemp(prefix="bench-memo-broken-"), "not-a-dir")
+    with open(broken_tier, "w", encoding="utf-8") as f:
+        f.write("memo tier outage stand-in")
+    cold = SimReplica(name="c0", service_ms=1.0,
+                      memo_dir=broken_tier,
+                      ring_members=["a", "b"],
+                      prewarm_deadline_s=1.0).start()
+    try:
+        t0 = time.perf_counter()
+        while cold.warming:
+            assert time.perf_counter() - t0 < 1.0 + 2.0, \
+                "cold join exceeded the prewarm deadline bound"
+            time.sleep(0.005)
+        out["cold_join_ready_s"] = round(
+            time.perf_counter() - t0, 4)
+        assert cold.counters["prewarm_cold_joins"] == 1, \
+            cold.counters
+        # the replica serves normally — the degraded tier cost
+        # warmth, never availability
+        router = ScanRouter([("c0", cold.url)])
+        status, body, _ = router.route(
+            SCAN_PATH, scan_raw(digests(1, "cold")[0]))
+        assert status == 200
+        assert json.loads(body)["memo_hit"] is False
+        out["cold_join_bounded"] = True
+        assert ROUTER_METRICS.snapshot()["lost"] == 0
+    finally:
+        cold.stop()
     ROUTER_METRICS.reset()
     return out
 

@@ -528,6 +528,26 @@ def build_parser() -> argparse.ArgumentParser:
                      "local tarballs named <ref with /:@ as _>.tar; "
                      "without it admission misses apply the fail "
                      "stance")
+    srv.add_argument("--compile-cache", default="",
+                     help="AOT shape precompile at boot into this "
+                     "persistent compilation cache directory "
+                     "(docs/serving.md 'Elastic lifecycle'): the "
+                     "bucket-ladder interval and DFA kernel shapes "
+                     "compile before /healthz goes ready, and a "
+                     "later boot of the same (jax version, backend, "
+                     "rule set) deserializes instead of rebuilding")
+    srv.add_argument("--prewarm-members", default="",
+                     help="comma-separated names of the replicas "
+                     "already on the routing ring: before /healthz "
+                     "reports ready this replica computes its post-"
+                     "join key ranges, walks the shared memo tier "
+                     "for them, and stages resident tables "
+                     "(docs/serving.md 'Elastic lifecycle'); "
+                     "requires the memo")
+    srv.add_argument("--prewarm-deadline", type=float, default=5.0,
+                     help="prewarm walk bound in seconds — past it "
+                     "the replica joins cold instead of wedging the "
+                     "scale-up")
     srv.add_argument("--profile-out", default="",
                      help="opt-in device trace: jax.profiler trace "
                      "into this directory plus the host profiler's "
@@ -1155,6 +1175,13 @@ def run_server(args) -> int:
         # the shared memo tier before taking queries — the
         # elasticity story (docs/serving.md)
         impact.rebuild(memo, store)
+    prewarm_members = [m.strip() for m in
+                       getattr(args, "prewarm_members",
+                               "").split(",") if m.strip()]
+    if prewarm_members and memo is None:
+        print("error: --prewarm-members needs the findings memo "
+              "(drop --no-memo)", file=sys.stderr)
+        return 2
     server = ScanServer(store=store,
                         cache_dir=args.cache_dir,
                         token=args.auth_token,
@@ -1166,7 +1193,12 @@ def run_server(args) -> int:
                         federator=federator,
                         replica_name=(
                             getattr(args, "replica_name", "")
-                            or args.listen))
+                            or args.listen),
+                        compile_cache_dir=getattr(
+                            args, "compile_cache", ""),
+                        prewarm_members=prewarm_members,
+                        prewarm_deadline_s=getattr(
+                            args, "prewarm_deadline", 5.0))
     server.fault_injector = injector
     adm_runner = None
     try:

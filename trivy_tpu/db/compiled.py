@@ -184,6 +184,31 @@ def resident_snapshot() -> list:
     return out
 
 
+def prewarm_resident() -> list:
+    """Stage every live resident table's default placement NOW —
+    the HBM-upload half of a joining replica's prewarm
+    (docs/serving.md "Elastic lifecycle"). ``device_tables`` is
+    idempotent per (generation, placement), so an already-staged
+    table is a no-op; a table whose upload fails (device pressure
+    mid-join) is skipped — prewarm is an optimization, the first
+    dispatch will stage it like before. Returns
+    ``[{table, generation, staged}]`` for the boot log."""
+    with _RESIDENT_REG_LOCK:
+        tables = list(_RESIDENT_REGISTRY)
+    out = []
+    for t in sorted(tables, key=lambda x: x._TABLE):
+        row = {"table": t._TABLE, "generation": t.generation,
+               "staged": True}
+        try:
+            t.device_tables()
+        except (RuntimeError, OSError, ValueError) as e:
+            log.warning("prewarm staging skipped %s: %r",
+                        t._TABLE, e)
+            row["staged"] = False
+        out.append(row)
+    return out
+
+
 class ResidentTables:
     """Device-residency plumbing shared by every table that lives in
     HBM across dispatches: the compiled advisory DB below and the

@@ -360,6 +360,61 @@ def render_prometheus(stats: dict, phase_hists=None,
             w.scalar(f"{_PREFIX}_{k}_total", "counter", help_,
                      memo.get(k))
 
+    lifecycle = stats.get("lifecycle") or {}
+    if lifecycle:
+        # elastic-lifecycle counters (docs/serving.md "Elastic
+        # lifecycle"): prewarm walk progress, drain-handoff flow,
+        # and the warming admission gate
+        for k, help_ in (
+                ("prewarm_keys",
+                 "Memo keys staged by pre-join prewarm walks."),
+                ("prewarm_bytes",
+                 "Memo payload bytes staged by prewarm walks."),
+                ("prewarm_seconds",
+                 "Wall seconds spent in prewarm walks."),
+                ("prewarm_deadline_exceeded",
+                 "Prewarm walks cut short by the deadline."),
+                ("prewarm_runs", "Prewarm walks started."),
+                ("prewarm_cold_joins",
+                 "Joins that went cold (partial or failed "
+                 "prewarm)."),
+                ("handoff_published",
+                 "Hot digests published by draining replicas."),
+                ("handoff_prefetched",
+                 "Handoff digests adopted by ring successors."),
+                ("handoff_abandoned",
+                 "Handoff digests no successor adopted.")):
+            w.scalar(f"{_PREFIX}_{k}_total", "counter", help_,
+                     lifecycle.get(k))
+        w.scalar(f"{_PREFIX}_warming", "gauge",
+                 "1 while this replica prewarms before admission.",
+                 1 if lifecycle.get("warming") else 0)
+        hot = lifecycle.get("hot") or {}
+        if hot:
+            w.scalar(f"{_PREFIX}_hot_digests", "gauge",
+                     "Digests in the bounded hot working-set book.",
+                     hot.get("entries"))
+
+    ccache = stats.get("compile_cache") or {}
+    if ccache:
+        # AOT compile-cache counters (docs/serving.md "Elastic
+        # lifecycle"): manifest hit/miss split + on-disk footprint
+        for k, help_ in (
+                ("hits",
+                 "Precompiles whose keyed shape an earlier boot "
+                 "already compiled."),
+                ("misses",
+                 "Precompiles that paid a fresh compile."),
+                ("bytes",
+                 "On-disk bytes in the persistent compilation "
+                 "cache.")):
+            w.scalar(f"{_PREFIX}_compile_cache_{k}", "counter"
+                     if k != "bytes" else "gauge", help_,
+                     ccache.get(k))
+        w.scalar(f"{_PREFIX}_compile_cache_seconds_total",
+                 "counter", "Wall seconds spent in boot "
+                 "precompiles.", ccache.get("seconds"))
+
     watch = stats.get("watch") or {}
     if watch:
         # watch-loop event dispositions + admission verdicts
@@ -691,8 +746,9 @@ def render_router(stats: dict, hists=None) -> str:
     w.scalar(f"{p}_replicas", "gauge",
              "Replicas on the ring.", len(replicas))
     w.scalar(f"{p}_replicas_routable", "gauge",
-             "Replicas eligible for NEW work (not draining, "
-             "breaker closed).", len(stats.get("routable") or []))
+             "Replicas eligible for NEW work (not draining, not "
+             "warming, breaker closed).",
+             len(stats.get("routable") or []))
     w.header(f"{p}_replica_inflight", "gauge",
              "Router-tracked in-flight requests per replica.")
     for rep in replicas:
@@ -705,6 +761,13 @@ def render_router(stats: dict, hists=None) -> str:
         w.sample(f"{p}_replica_draining",
                  [("replica", rep.get("name", ""))],
                  1 if rep.get("draining") else 0)
+    w.header(f"{p}_replica_warming", "gauge",
+             "Replica prewarm state (1 = joined the ring, not yet "
+             "admitted; flips on the first ready health probe).")
+    for rep in replicas:
+        w.sample(f"{p}_replica_warming",
+                 [("replica", rep.get("name", ""))],
+                 1 if rep.get("warming") else 0)
     w.header(f"{p}_replica_breaker_state", "gauge",
              "Circuit-breaker state per replica (one-hot).")
     for rep in replicas:
@@ -713,6 +776,30 @@ def render_router(stats: dict, hists=None) -> str:
             w.sample(f"{p}_replica_breaker_state",
                      [("replica", rep.get("name", "")),
                       ("state", s)], 1 if s == state else 0)
+
+    lifecycle = stats.get("lifecycle") or {}
+    if lifecycle:
+        # elastic-lifecycle counters booked by THIS process: the
+        # autoscaler's drain-handoff orchestration (docs/serving.md
+        # "Elastic lifecycle"); replica-side prewarm counters live
+        # on each replica's own /metrics
+        for k, help_ in (
+                ("handoff_published",
+                 "Hot digests pulled from draining replicas."),
+                ("handoff_prefetched",
+                 "Handoff digests adopted by ring successors."),
+                ("handoff_abandoned",
+                 "Handoff digests no successor adopted."),
+                ("prewarm_keys",
+                 "Memo keys staged by prewarm walks."),
+                ("prewarm_bytes",
+                 "Memo payload bytes staged by prewarm walks."),
+                ("prewarm_seconds",
+                 "Wall seconds spent in prewarm walks."),
+                ("prewarm_deadline_exceeded",
+                 "Prewarm walks cut short by the deadline.")):
+            w.scalar(f"{_PREFIX}_{k}_total", "counter", help_,
+                     lifecycle.get(k))
 
     w.scalar(f"{p}_affinity_entries", "gauge",
              "Cache-session affinity entries (id -> route key).",

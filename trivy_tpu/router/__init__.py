@@ -27,6 +27,9 @@ _EXPORTS = {
     "ReplicaController": "scaler", "SimReplicaController": "scaler",
     "SubprocessReplicaController": "scaler", "decide": "scaler",
     "SimReplica": "sim",
+    "LifecycleMetrics": "lifecycle", "LIFECYCLE_METRICS": "lifecycle",
+    "prewarm_ranges": "lifecycle", "plan_handoff": "lifecycle",
+    "run_handoff": "lifecycle", "fetch_handoff": "lifecycle",
 }
 
 __all__ = sorted(_EXPORTS)

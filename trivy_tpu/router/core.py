@@ -73,11 +73,18 @@ class ReplicaHandle:
     """One backend replica: endpoint, breaker, probed health."""
 
     def __init__(self, name: str, url: str,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 warming: bool = False):
         self.name = name
         self.url = url.rstrip("/")
         self.breaker = breaker or CircuitBreaker()
         self.draining = False
+        # warming: on the ring (membership — reshard already paid)
+        # but NOT routable until its prewarm completes; the prober
+        # tracks the replica's own /healthz ``warming`` flag, so a
+        # replica that restarts mid-probe-interval is re-admitted
+        # only when warm again, never cold
+        self.warming = warming
         self.inflight = 0            # router-side in-flight count
         self.probed_inflight = 0     # replica-reported (healthz)
         self.probe_ok = True
@@ -86,6 +93,7 @@ class ReplicaHandle:
     def stats(self) -> dict:
         return {"name": self.name, "url": self.url,
                 "draining": self.draining,
+                "warming": self.warming,
                 "inflight": self.inflight,
                 "probed_inflight": self.probed_inflight,
                 "probe_ok": self.probe_ok,
@@ -134,11 +142,18 @@ class ScanRouter:
 
     # ---- membership (ring churn happens ONLY here) ----
 
-    def add_replica(self, name: str, url: str) -> None:
+    def add_replica(self, name: str, url: str,
+                    warming: bool = False) -> None:
+        """``warming=True`` puts the replica on the ring (membership
+        — the reshard happens now, once) but keeps it out of the
+        routable set until its prewarm completes and a probe sees
+        ``warming: false`` on /healthz (docs/serving.md "Elastic
+        lifecycle")."""
         with self._lock:
             if name in self._replicas:
                 return
-            self._replicas[name] = ReplicaHandle(name, url)
+            self._replicas[name] = ReplicaHandle(name, url,
+                                                 warming=warming)
         self.ring.add(name)
         ROUTER_METRICS.inc("ring_churn")
         ROUTER_METRICS.set_inflight(name, 0)
@@ -170,16 +185,28 @@ class ScanRouter:
             if h is not None:
                 h.draining = draining
 
+    def mark_warming(self, name: str,
+                     warming: bool = True) -> None:
+        """Flip a replica's warming overlay (tests and proberless
+        embedders; with a prober running the replica's own /healthz
+        is authoritative)."""
+        with self._lock:
+            h = self._replicas.get(name)
+            if h is not None:
+                h.warming = warming
+
     # ---- routing-set overlay (health never reshards the ring) ----
 
     def _unroutable(self) -> set:
-        """Replicas excluded from NEW work: draining, or breaker not
+        """Replicas excluded from NEW work: draining, warming (on
+        the ring but prewarm not yet complete), or breaker not
         CLOSED (half-open probes belong to the prober, not to a
         client's request)."""
         out = set()
         with self._lock:
             for name, h in self._replicas.items():
-                if h.draining or h.breaker.state != CLOSED:
+                if h.draining or h.warming \
+                        or h.breaker.state != CLOSED:
                     out.add(name)
         return out
 
@@ -492,6 +519,11 @@ class HealthProber(threading.Thread):
             log.info("replica %s recovered", handle.name)
         handle.probe_ok = True
         handle.draining = bool(doc.get("draining"))
+        # the replica's own ready-state machine is authoritative: a
+        # restarted replica re-announcing ``warming`` is NOT
+        # re-admitted cold, and one that finished its prewarm is
+        # admitted on the next probe — one probe interval, by design
+        handle.warming = bool(doc.get("warming"))
         try:
             handle.probed_inflight = int(doc.get("inflight") or 0)
         except (TypeError, ValueError):

@@ -55,9 +55,14 @@ class RouterServer:
 
     def metrics(self) -> dict:
         from ..obs.procstats import process_self_stats
+        from .lifecycle import LIFECYCLE_METRICS
         out = self.router.stats()
         if self.scaler is not None:
             out["scaler"] = self.scaler.stats()
+        # drain-handoff orchestration runs IN this process
+        # (Autoscaler scale-down → lifecycle.run_handoff), so the
+        # router front carries the lifecycle families too
+        out["lifecycle"] = LIFECYCLE_METRICS.snapshot()
         out["process"] = process_self_stats()
         return out
 

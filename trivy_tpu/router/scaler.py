@@ -57,15 +57,27 @@ class ScalerPolicy:
 
 
 def decide(slo_ok: bool, complete: bool, avg_inflight: float,
-           n: int, calm: int,
-           policy: ScalerPolicy) -> Tuple[str, str]:
+           n: int, calm: int, policy: ScalerPolicy, *,
+           warming: int = 0) -> Tuple[str, str]:
     """Pure scaling decision: ("up"|"down"|"hold", reason).
     ``calm`` is the caller's count of consecutive calm ticks BEFORE
-    this one."""
+    this one. ``warming`` is the count of replicas still
+    prewarming: they don't serve yet, so they don't count toward
+    ``n`` or the in-flight average — but a burn-rate trip while one
+    is in flight holds instead of stacking a second scale-up (the
+    hysteresis covers the prewarm window, not just the cooldown)."""
     if not slo_ok:
-        if n < policy.max_replicas:
+        if warming > 0:
+            return "hold", (f"slo burning but {warming} replica(s) "
+                            "still prewarming — scale-up in flight")
+        if n + warming < policy.max_replicas:
             return "up", "fleet slo burn-rate trip"
         return "hold", "slo burning but fleet at max_replicas"
+    if warming > 0:
+        # never shrink under a join in flight: the prewarming
+        # replica is about to take ring ranges; draining a peer at
+        # the same time would churn the ring twice in one window
+        return "hold", f"{warming} replica(s) prewarming"
     if n > policy.min_replicas \
             and avg_inflight < policy.low_inflight:
         if policy.require_complete and not complete:
@@ -81,9 +93,19 @@ def decide(slo_ok: bool, complete: bool, avg_inflight: float,
 class ReplicaController:
     """Actuation interface the autoscaler drives. Implementations
     must make ``start`` return a ready-to-probe endpoint and make
-    ``stop`` safe on an already-dead replica."""
+    ``stop`` safe on an already-dead replica.
 
-    def start(self) -> Tuple[str, str]:
+    ``prewarm_enabled`` tells the scaler whether a started replica
+    boots in the ``warming`` state (docs/serving.md "Elastic
+    lifecycle"): when True the scaler admits it to the ring as
+    warming (unroutable until its /healthz flips) and passes the
+    current ring membership into ``start`` so the replica can
+    compute its post-join key ranges before serving."""
+
+    prewarm_enabled = False
+
+    def start(self, ring_members: Optional[List[str]] = None,
+              ) -> Tuple[str, str]:
         """Launch one replica; returns (name, url)."""
         raise NotImplementedError
 
@@ -106,11 +128,19 @@ class SimReplicaController(ReplicaController):
         self._n = 0
         self.replicas: Dict[str, object] = {}
 
-    def start(self) -> Tuple[str, str]:
+    @property
+    def prewarm_enabled(self) -> bool:
+        return bool(self.sim_kwargs.get("memo_dir"))
+
+    def start(self, ring_members: Optional[List[str]] = None,
+              ) -> Tuple[str, str]:
         from .sim import SimReplica
         name = f"{self.prefix}-{self._n}"
         self._n += 1
-        sim = SimReplica(name=name, **self.sim_kwargs).start()
+        kwargs = dict(self.sim_kwargs)
+        if self.prewarm_enabled and ring_members:
+            kwargs.setdefault("ring_members", list(ring_members))
+        sim = SimReplica(name=name, **kwargs).start()
         self.replicas[name] = sim
         return name, sim.url
 
@@ -149,14 +179,23 @@ class SubprocessReplicaController(ReplicaController):
         self.procs: Dict[str, object] = {}
         self.urls: Dict[str, str] = {}
 
-    def start(self) -> Tuple[str, str]:
+    @property
+    def prewarm_enabled(self) -> bool:
+        return "--memo-dir" in self.extra_args
+
+    def start(self, ring_members: Optional[List[str]] = None,
+              ) -> Tuple[str, str]:
         import subprocess
         import sys
         name = f"{self.prefix}-{self._n}"
         self._n += 1
+        args = list(self.extra_args)
+        if self.prewarm_enabled and ring_members \
+                and "--ring-members" not in args:
+            args += ["--ring-members", ",".join(ring_members)]
         proc = subprocess.Popen(
             [sys.executable, "-m", "trivy_tpu.router.sim",
-             "--name", name, "--port", "0"] + self.extra_args,
+             "--name", name, "--port", "0"] + args,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True)
         # the replica prints "PORT <n>" once bound; readline blocks
@@ -248,10 +287,12 @@ class Autoscaler:
     def __init__(self, router, controller: ReplicaController,
                  policy: Optional[ScalerPolicy] = None,
                  verdict_fn: Optional[Callable[[], dict]] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 handoff_timeout_s: float = 5.0):
         self.router = router
         self.controller = controller
         self.policy = policy or ScalerPolicy()
+        self.handoff_timeout_s = handoff_timeout_s
         self.verdict_fn = verdict_fn or federated_verdicts(router)
         self._clock = clock
         self._calm = 0
@@ -277,20 +318,31 @@ class Autoscaler:
                 log.info("scale-down victim %s quiesced and "
                          "stopped", name)
 
-    def _avg_inflight(self) -> Tuple[float, int]:
-        handles = [h for h in self.router.replicas()
-                   if not h.draining]
-        if not handles:
-            return 0.0, 0
+    def _avg_inflight(self) -> Tuple[float, int, int]:
+        """(avg inflight over SERVING replicas, serving count,
+        warming count). A prewarming replica serves nothing yet, so
+        counting it would both dilute the average and overstate
+        capacity — it is capacity in flight, not capacity."""
+        serving = []
+        warming = 0
+        for h in self.router.replicas():
+            if h.draining:
+                continue
+            if h.warming:
+                warming += 1
+                continue
+            serving.append(h)
+        if not serving:
+            return 0.0, 0, warming
         total = sum(max(h.inflight, h.probed_inflight)
-                    for h in handles)
-        return total / len(handles), len(handles)
+                    for h in serving)
+        return total / len(serving), len(serving), warming
 
     def tick(self, verdict: Optional[dict] = None) -> dict:
         self._finish_drains()
         if verdict is None:
             verdict = self.verdict_fn()
-        avg, n = self._avg_inflight()
+        avg, n, warming = self._avg_inflight()
         now = self._clock()
         in_cooldown = (self._last_event is not None and
                        now - self._last_event
@@ -301,13 +353,27 @@ class Autoscaler:
             action, reason = decide(
                 bool(verdict.get("slo_ok", True)),
                 bool(verdict.get("complete", False)),
-                avg, n, self._calm, self.policy)
+                avg, n, self._calm, self.policy,
+                warming=warming)
         calm_now = bool(verdict.get("slo_ok", True)) \
             and avg < self.policy.low_inflight
         self._calm = self._calm + 1 if calm_now else 0
         if action == "up":
-            name, url = self.controller.start()
-            self.router.add_replica(name, url)
+            members = [h.name for h in self.router.replicas()
+                       if not h.draining]
+            try:
+                name, url = self.controller.start(
+                    ring_members=members)
+            except TypeError:
+                # a pre-lifecycle controller with a bare start():
+                # joins cold, exactly like before this contract
+                name, url = self.controller.start()
+            # a prewarm-enabled controller's replica joins the ring
+            # WARMING: membership (and its one reshard) happen now,
+            # but the router admits it only when its /healthz flips
+            self.router.add_replica(
+                name, url,
+                warming=bool(self.controller.prewarm_enabled))
             ROUTER_METRICS.inc("scale_ups")
             self._last_event = now
             self._calm = 0
@@ -325,12 +391,20 @@ class Autoscaler:
                 ROUTER_METRICS.inc("drains_started")
                 self._last_event = now
                 self._calm = 0
+                # drain handoff: publish the victim's hot-digest
+                # set to its ring successors while its in-flight
+                # work finishes — best-effort, never blocks the
+                # drain (docs/serving.md "Elastic lifecycle")
+                from .lifecycle import run_handoff
+                run_handoff(self.router, victim,
+                            timeout_s=self.handoff_timeout_s)
                 log.info("scale DOWN: draining %s (%s)",
                          victim, reason)
         else:
             ROUTER_METRICS.inc("scale_holds")
         event = {"action": action, "reason": reason,
-                 "replicas": n, "avg_inflight": round(avg, 3),
+                 "replicas": n, "warming": warming,
+                 "avg_inflight": round(avg, 3),
                  "slo_ok": bool(verdict.get("slo_ok", True)),
                  "complete": bool(verdict.get("complete", False)),
                  "draining": sorted(self._draining)}

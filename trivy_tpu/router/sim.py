@@ -12,9 +12,21 @@ that matter for fleet behavior:
   finite parallelism, so aggregate throughput should scale with the
   replica count — the bench's ≥ 0.8×N gate is meaningless against an
   infinitely parallel sleep;
-* per-replica warm state: the set of layer digests this replica has
-  seen; a repeat of a known base digest answers ``memo_hit: true`` —
-  the signal the post-reshard warm-hit bench measures;
+* per-replica warm state: the recency-ordered book of layer digests
+  this replica has seen; a repeat of a known base digest answers
+  ``memo_hit: true`` — the signal the post-reshard warm-hit bench
+  measures;
+* the elastic lifecycle (docs/serving.md "Elastic lifecycle"): with
+  ``memo_dir`` the replica write-throughs every digest it warms into
+  a shared directory (the sim stand-in for the redis/s3 memo tier);
+  given ``ring_members`` it boots in a ``warming`` state, computes
+  the key ranges the post-join ring will assign it (the ring is a
+  pure cross-process function), stages exactly those digests from
+  the shared tier, and only then flips ``/healthz`` to ready — all
+  under ``prewarm_deadline_s``, so an unreadable/slow memo tier
+  degrades to a bounded cold join instead of wedging the scale-up.
+  ``GET /handoff`` exports the hot set for a draining replica's
+  successors; ``POST /prefetch`` is how they take it;
 * seeded faults: ``kill_after=N`` hard-exits the process mid-request
   after N scans (replica death mid-storm), ``flaky_every=N`` does
   the work then drops every Nth response (the lost-response hazard
@@ -51,6 +63,15 @@ SCANNER_PREFIX = "/twirp/trivy.scanner.v1.Scanner/"
 CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
 TENANT_HEADER = "Trivy-Tenant"
 IDEM_CAP = 4096
+HOT_CAP = 4096                  # bounded warm-set recency book
+
+
+def _memo_fname(digest: str) -> str:
+    """Digest -> shared-memo-dir marker filename (path-safe). The
+    original digest rides as file CONTENT because the sanitization
+    is not reversible."""
+    return "".join(c if c.isalnum() or c in "._-" else "_"
+                   for c in digest)[:200]
 
 
 class SimReplica:
@@ -65,7 +86,12 @@ class SimReplica:
                  flaky_every: int = 0,
                  tenant_rate: float = 0.0,
                  seed: int = 20260804,
-                 slo_availability: float = 0.99):
+                 slo_availability: float = 0.99,
+                 memo_dir: str = "",
+                 ring_members=None,
+                 prewarm_deadline_s: float = 5.0,
+                 prewarm_delay_ms: float = 0.0,
+                 hot_cap: int = HOT_CAP):
         self.name = name
         self.addr = addr
         self._port = port
@@ -78,18 +104,40 @@ class SimReplica:
         self.tenant_rate = max(0.0, tenant_rate)
         self._sem = threading.BoundedSemaphore(self.max_concurrent)
         self._lock = threading.Lock()
-        self._warm: set = set()          # layer digests seen
+        # layer digests seen, recency-ordered (oldest first) with
+        # refcounts, bounded at hot_cap — the /handoff export is
+        # this book's tail, never an unbounded history
+        self._warm: OrderedDict = OrderedDict()
+        self.hot_cap = max(1, hot_cap)
         self._blobs: set = set()         # cache-tier blob ids
         self._idem: OrderedDict = OrderedDict()  # key -> response
         self._buckets: dict = {}         # tenant -> (tokens, last)
         self.draining = False
         self.inflight = 0
+        # elastic-lifecycle knobs: the shared memo tier is a
+        # directory of digest marker files (the sim stand-in for
+        # redis/s3); ring_members given => boot warming and prewarm
+        # the post-join key ranges before flipping ready
+        self.memo_dir = memo_dir
+        self.ring_members = [str(m) for m in ring_members or []
+                             if str(m)]
+        self.prewarm_deadline_s = max(0.0, prewarm_deadline_s)
+        self.prewarm_delay_ms = max(0.0, prewarm_delay_ms)
+        self.warming = bool(self.memo_dir and self.ring_members)
+        self.prewarm_seconds = 0.0
         self.counters = {"scans": 0, "memo_hits": 0, "deduped": 0,
                          "dropped": 0, "rate_limited": 0,
                          "cache_ops": 0, "drained_rejects": 0,
                          "chaos_errors": 0, "chaos_drops": 0,
                          "db_swaps": 0, "hostile_quarantined": 0,
-                         "cache_op_errors": 0}
+                         "cache_op_errors": 0,
+                         "prewarm_runs": 0, "prewarm_keys": 0,
+                         "prewarm_bytes": 0,
+                         "prewarm_deadline_exceeded": 0,
+                         "prewarm_cold_joins": 0,
+                         "handoff_published": 0,
+                         "handoff_prefetched": 0,
+                         "handoff_abandoned": 0}
         # runtime chaos knobs, steered via POST /chaos mid-run
         import random
         self._chaos_rng = random.Random(seed)
@@ -131,7 +179,134 @@ class SimReplica:
             target=self._httpd.serve_forever, daemon=True,
             name=f"sim-{self.name}")
         self._thread.start()
+        if self.warming:
+            threading.Thread(target=self._prewarm, daemon=True,
+                             name=f"sim-{self.name}-prewarm").start()
         return self
+
+    # ---- elastic lifecycle (docs/serving.md "Elastic lifecycle") --
+
+    def _touch_warm(self, digests) -> list:
+        """Insert/refresh digests in the recency book; returns the
+        NEWLY seen ones (the write-through set for the shared memo
+        tier). Lock held briefly; no IO here."""
+        fresh = []
+        with self._lock:
+            for d in digests:
+                if not d:
+                    continue
+                if d not in self._warm:
+                    fresh.append(d)
+                self._warm[d] = self._warm.get(d, 0) + 1
+                self._warm.move_to_end(d)
+            while len(self._warm) > self.hot_cap:
+                self._warm.popitem(last=False)
+        return fresh
+
+    def _memo_publish(self, digests) -> None:
+        """Write-through to the shared memo tier (one marker file
+        per digest, content = the digest). Best-effort: the tier
+        degrading must never fail a scan."""
+        if not self.memo_dir:
+            return
+        try:
+            os.makedirs(self.memo_dir, exist_ok=True)
+        except OSError:
+            # memo-tier outage: scans still work, joins go cold
+            return
+        for d in digests:
+            path = os.path.join(self.memo_dir, _memo_fname(d))
+            if os.path.exists(path):
+                continue
+            try:
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(d)
+            except OSError:
+                # memo-tier outage: scans still work, joins go cold
+                break
+
+    def _memo_digests(self) -> list:
+        """Shared-tier listing, newest-written first, so a deadline
+        cut mid-walk keeps the most recently published (hottest)
+        entries staged. Empty on outage — the caller degrades to a
+        cold join."""
+        try:
+            entries = []
+            with os.scandir(self.memo_dir) as it:
+                for e in it:
+                    if e.is_file():
+                        entries.append((e.stat().st_mtime, e.path))
+        except OSError:
+            return []
+        out = []
+        for _mt, path in sorted(entries, reverse=True):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    d = f.read().strip()
+            except OSError:
+                continue
+            if d:
+                out.append(d)
+        return out
+
+    def _prewarm(self) -> None:
+        """Pre-join prewarm: compute the key ranges the POST-join
+        ring assigns this replica (pure cross-process placement),
+        stage them from the shared memo tier, then flip ready.
+        Bounded by prewarm_deadline_s — deadline hit or tier outage
+        degrades to a cold join, never a wedged scale-up."""
+        from .lifecycle import prewarm_ranges
+        self._inc("prewarm_runs")
+        t0 = time.monotonic()
+        digests = self._memo_digests()
+        staged = 0
+        nbytes = 0
+        exceeded = False
+        if digests:
+            owned = prewarm_ranges(self.ring_members, self.name,
+                                   digests)
+            for d in owned:
+                if self.prewarm_deadline_s and \
+                        time.monotonic() - t0 \
+                        >= self.prewarm_deadline_s:
+                    exceeded = True
+                    break
+                if self.prewarm_delay_ms:
+                    # simulated memo-tier fetch latency (the bench's
+                    # degraded-tier arm drives the deadline with it)
+                    time.sleep(self.prewarm_delay_ms / 1000.0)
+                self._touch_warm([d])
+                staged += 1
+                nbytes += len(d)
+        self.prewarm_seconds = round(time.monotonic() - t0, 6)
+        self._inc("prewarm_keys", staged)
+        self._inc("prewarm_bytes", nbytes)
+        if exceeded:
+            self._inc("prewarm_deadline_exceeded")
+            self._inc("prewarm_cold_joins")
+        elif not digests:
+            self._inc("prewarm_cold_joins")
+        self.warming = False
+
+    def handoff(self) -> dict:
+        """``GET /handoff`` — the recency-ordered hot-digest export
+        (oldest first, hottest last) a drain orchestrator feeds to
+        :func:`trivy_tpu.router.lifecycle.plan_handoff`."""
+        with self._lock:
+            digests = list(self._warm)
+        self._inc("handoff_published", len(digests))
+        return {"name": self.name, "draining": self.draining,
+                "digests": digests}
+
+    def prefetch(self, body: dict) -> dict:
+        """``POST /prefetch`` — take a departing peer's hot digests
+        into this replica's warm state (no service time: a prefetch
+        is a memo pull, not a scan)."""
+        digests = [str(d) for d in body.get("digests") or [] if d]
+        fresh = self._touch_warm(digests)
+        self._memo_publish(fresh)
+        self._inc("handoff_prefetched", len(digests))
+        return {"accepted": len(digests), "name": self.name}
 
     def drain(self) -> None:
         self.draining = True
@@ -245,7 +420,10 @@ class SimReplica:
         with self._lock:
             self.inflight += 1
             hit = base in self._warm if base else False
-            self._warm.update(b for b in blob_ids if b)
+        fresh = self._touch_warm(blob_ids)
+        # write-through to the shared memo tier so a future joiner's
+        # prewarm walk finds this replica's warm work
+        self._memo_publish(fresh)
         try:
             with self._sem:             # finite parallelism
                 if self.service_ms:
@@ -318,7 +496,7 @@ class SimReplica:
             elif op == "DeleteBlobs":
                 for b in body.get("blob_ids") or []:
                     self._blobs.discard(str(b))
-                    self._warm.discard(str(b))
+                    self._warm.pop(str(b), None)
             elif op == "MissingBlobs":
                 blob_ids = [str(b)
                             for b in body.get("blob_ids") or []]
@@ -331,8 +509,15 @@ class SimReplica:
     def health(self) -> dict:
         with self._lock:
             inflight = self.inflight
-        return {"status": "draining" if self.draining else "ok",
+        if self.draining:
+            status = "draining"
+        elif self.warming:
+            status = "warming"
+        else:
+            status = "ok"
+        return {"status": status,
                 "draining": self.draining,
+                "warming": self.warming,
                 "inflight": inflight,
                 "build": {"replica": self.name, "sim": True}}
 
@@ -346,6 +531,8 @@ class SimReplica:
             out["inflight"] = self.inflight
             out["db_generation"] = self.db_generation
         out["draining"] = self.draining
+        out["warming"] = self.warming
+        out["prewarm_seconds"] = self.prewarm_seconds
         out["name"] = self.name
         out["process"] = process_self_stats()
         out["slo"] = self.slo.snapshot()
@@ -368,6 +555,26 @@ class SimReplica:
             lines.append(
                 f'trivy_tpu_sim_events_total{{event="{k}"}} '
                 f"{m.get(k, 0)}")
+        # the elastic-lifecycle families by their fleet-wide names
+        # (docs/serving.md "Elastic lifecycle") — same spellings the
+        # real server and the router front expose, so a merged
+        # federation view aggregates sim and real replicas alike
+        for kind, fams in (
+                ("prewarm", ("keys", "bytes", "deadline_exceeded")),
+                ("handoff", ("published", "prefetched",
+                             "abandoned"))):
+            for sub in fams:
+                fam = f"trivy_tpu_{kind}_{sub}_total"
+                lines.append(f"# HELP {fam} Elastic-lifecycle "
+                             f"{kind} counter.")
+                lines.append(f"# TYPE {fam} counter")
+                lines.append(f"{fam} {m.get(f'{kind}_{sub}', 0)}")
+        lines.append("# HELP trivy_tpu_prewarm_seconds_total Wall "
+                     "seconds spent in prewarm walks.")
+        lines.append("# TYPE trivy_tpu_prewarm_seconds_total "
+                     "counter")
+        lines.append("trivy_tpu_prewarm_seconds_total "
+                     f"{m.get('prewarm_seconds', 0.0)}")
         proc = m.get("process") or {}
         for key, fam in (("rss_bytes",
                           "trivy_tpu_process_rss_bytes"),
@@ -418,6 +625,8 @@ def _make_handler(sim: SimReplica):
                 self._reply(200, sim.metrics())
             elif self.path == "/metrics/snapshot":
                 self._reply(200, sim.metrics_snapshot())
+            elif self.path == "/handoff":
+                self._reply(200, sim.handoff())
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})
@@ -453,7 +662,9 @@ def _make_handler(sim: SimReplica):
                 return
             if not isinstance(body, dict):
                 body = {}
-            if self.path == SCANNER_PREFIX + "Scan":
+            if self.path == "/prefetch":
+                self._reply(200, sim.prefetch(body))
+            elif self.path == SCANNER_PREFIX + "Scan":
                 tenant = str(body.get("tenant")
                              or self.headers.get(TENANT_HEADER)
                              or "")
@@ -504,7 +715,19 @@ def main(argv=None) -> int:
     p.add_argument("--tenant-rate", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=20260804)
     p.add_argument("--slo-availability", type=float, default=0.99)
+    p.add_argument("--memo-dir", default="",
+                   help="shared memo-tier directory (write-through "
+                        "warm state; enables prewarm when "
+                        "--ring-members is also given)")
+    p.add_argument("--ring-members", default="",
+                   help="comma-separated current fleet names; boot "
+                        "in the warming state and prewarm the "
+                        "post-join key ranges before flipping ready")
+    p.add_argument("--prewarm-deadline-s", type=float, default=5.0)
+    p.add_argument("--prewarm-delay-ms", type=float, default=0.0)
+    p.add_argument("--hot-cap", type=int, default=HOT_CAP)
     args = p.parse_args(argv)
+    members = [m for m in args.ring_members.split(",") if m]
     sim = SimReplica(name=args.name, port=args.port,
                      addr=args.addr, service_ms=args.service_ms,
                      max_concurrent=args.max_concurrent,
@@ -512,7 +735,12 @@ def main(argv=None) -> int:
                      flaky_every=args.flaky_every,
                      tenant_rate=args.tenant_rate,
                      seed=args.seed,
-                     slo_availability=args.slo_availability).start()
+                     slo_availability=args.slo_availability,
+                     memo_dir=args.memo_dir,
+                     ring_members=members,
+                     prewarm_deadline_s=args.prewarm_deadline_s,
+                     prewarm_delay_ms=args.prewarm_delay_ms,
+                     hot_cap=args.hot_cap).start()
     print(f"PORT {sim.port}", flush=True)
     try:
         while True:

@@ -200,7 +200,9 @@ class ScanServer:
                  tracer=None, slos=None, memo=None,
                  admission=None, watch_source=None,
                  federator=None, replica_name: str = "self",
-                 impact=None):
+                 impact=None, compile_cache_dir: str = "",
+                 prewarm_members=None,
+                 prewarm_deadline_s: float = 5.0):
         self.max_body_bytes = max_body_bytes
         self.max_scan_blobs = max_scan_blobs
         if isinstance(store, SwappableStore):
@@ -302,6 +304,71 @@ class ScanServer:
         self.impact = impact
         if impact is not None and memo is not None:
             memo.attach_impact(impact)
+        # elastic lifecycle (docs/serving.md "Elastic lifecycle"):
+        # the hot-digest recency book (exported on GET /handoff so a
+        # drain's ring successors prefetch the moving working set),
+        # the boot-time AOT shape precompile against a persistent
+        # compilation cache, and the pre-join memo prewarm that
+        # keeps /healthz in the ``warming`` state until the post-
+        # join key ranges are staged (or the deadline bounds the
+        # walk into a cold join)
+        from ..memo.warmth import HotSet
+        self.hot = HotSet()
+        self._warming = False
+        self.compile_cache: dict = {}
+        if compile_cache_dir:
+            from ..runtime.aot import boot_precompile
+            self.compile_cache = boot_precompile(
+                cache_dir=compile_cache_dir)
+        if prewarm_members and self.memo is not None:
+            self._warming = True
+            threading.Thread(
+                target=self._prewarm,
+                args=(list(prewarm_members),
+                      max(0.0, prewarm_deadline_s)),
+                daemon=True,
+                name="scan-server-prewarm").start()
+
+    def _prewarm(self, members, deadline_s: float) -> None:
+        """Pre-join prewarm: the memo KEYSPACE is partitioned by
+        hashing key strings on the post-join ring (deterministic
+        cross-process, like request routing — though keys hash
+        independently of the route digests), the owned slice is
+        walked out of the shared tier (staging page/transport
+        caches and proving reachability), and the resident
+        advisory/DFA tables are staged into device memory. Only
+        then does /healthz flip from ``warming`` — bounded by
+        ``deadline_s``, so a degraded memo tier costs warmth, never
+        the scale-up."""
+        from ..router.lifecycle import LIFECYCLE_METRICS
+        from ..router.ring import Ring
+        LIFECYCLE_METRICS.inc("prewarm_runs")
+        try:
+            ring = Ring()
+            for m in members:
+                ring.add(str(m))
+            ring.add(self.replica_name)
+            try:
+                from ..db.compiled import prewarm_resident
+                prewarm_resident()
+            except (RuntimeError, OSError, ValueError) as e:
+                log.warning("resident prewarm degraded: %r", e)
+            from ..memo.warmth import range_walk
+            res = range_walk(
+                self.memo.store,
+                lambda k: ring.owner(k) == self.replica_name,
+                deadline_s)
+            LIFECYCLE_METRICS.inc("prewarm_keys", res["keys"])
+            LIFECYCLE_METRICS.inc("prewarm_bytes", res["bytes"])
+            LIFECYCLE_METRICS.add_seconds(res["seconds"])
+            if res["deadline_exceeded"]:
+                LIFECYCLE_METRICS.inc("prewarm_deadline_exceeded")
+            if not res["complete"]:
+                LIFECYCLE_METRICS.inc("prewarm_cold_joins")
+        finally:
+            # ready is unconditional: prewarm buys warmth, it never
+            # gates liveness past its deadline
+            self._warming = False
 
     def build_info(self) -> dict:
         """The trivy_tpu_build_info identity labels (also mirrored
@@ -325,8 +392,15 @@ class ScanServer:
         reaches zero)."""
         with self._inflight_lock:
             inflight = self._inflight
-        return {"status": "draining" if self._draining else "ok",
+        if self._draining:
+            status = "draining"
+        elif self._warming:
+            status = "warming"
+        else:
+            status = "ok"
+        return {"status": status,
                 "draining": self._draining,
+                "warming": self._warming,
                 "inflight": inflight,
                 "build": self.build_info()}
 
@@ -340,6 +414,31 @@ class ScanServer:
         """New Scan RPCs answer 503 from here on; queued and
         in-flight work keeps running until shutdown_gracefully."""
         self._draining = True
+
+    def handoff(self) -> dict:
+        """``GET /handoff`` — the hot-digest export (recency order,
+        hottest last) a drain orchestrator feeds to
+        ``router.lifecycle.plan_handoff`` so ring successors warm
+        up while this replica's in-flight work finishes."""
+        from ..router.lifecycle import LIFECYCLE_METRICS
+        digests = self.hot.export()
+        LIFECYCLE_METRICS.inc("handoff_published", len(digests))
+        return {"name": self.replica_name,
+                "draining": self._draining,
+                "digests": digests}
+
+    def prefetch(self, body: dict) -> dict:
+        """``POST /prefetch`` — take a departing peer's hot digests
+        into this replica's hot book. The verdict payloads live in
+        the SHARED memo tier, so adoption is bookkeeping, not a
+        copy: the next scan of an adopted digest is a memo hit."""
+        from ..router.lifecycle import LIFECYCLE_METRICS
+        digests = [str(d) for d in body.get("digests") or [] if d]
+        for d in digests:
+            self.hot.touch(d)
+        LIFECYCLE_METRICS.inc("handoff_prefetched", len(digests))
+        return {"accepted": len(digests),
+                "name": self.replica_name}
 
     def shutdown_gracefully(self, timeout_s: float = 30.0) -> bool:
         """SIGTERM path: 503 new work, drain the admission queue,
@@ -383,6 +482,11 @@ class ScanServer:
         on (or replays) the first enqueue's outcome instead."""
         if self._draining:
             raise ServerDraining("server draining, retry elsewhere")
+        blob_ids = body.get("blob_ids") or []
+        if blob_ids:
+            # hot-digest book: the base layer digest is the route
+            # key a scale-down's successors prefetch on
+            self.hot.touch(str(blob_ids[0]))
         with self._inflight_lock:
             self._inflight += 1
         try:
@@ -581,6 +685,15 @@ class ScanServer:
             out["impact"] = self.impact.stats()
         if "slo" not in out:
             out["slo"] = self.slo.snapshot()
+        # elastic-lifecycle counters (prewarm/handoff) and the AOT
+        # compile-cache split — identical section shape on both
+        # sched modes (docs/serving.md "Elastic lifecycle")
+        from ..router.lifecycle import LIFECYCLE_METRICS
+        from ..runtime.aot import COMPILE_CACHE_METRICS
+        out["lifecycle"] = dict(LIFECYCLE_METRICS.snapshot(),
+                                warming=self._warming,
+                                hot=self.hot.snapshot())
+        out["compile_cache"] = COMPILE_CACHE_METRICS.snapshot()
         out["profiler"] = self.profiler.stats()
         out["admission"] = {"max_body_bytes": self.max_body_bytes,
                             "max_scan_blobs": self.max_scan_blobs}
@@ -837,6 +950,13 @@ def _make_handler(server: ScanServer):
                 if not self._authorized():
                     return
                 self._reply(200, server.slo_verdicts())
+            elif self.path == "/handoff":
+                # drain handoff (docs/serving.md "Elastic
+                # lifecycle"): the hot-digest working set a ring
+                # successor prefetches — operational, token-gated
+                if not self._authorized():
+                    return
+                self._reply(200, server.handoff())
             elif self.path.startswith("/debug/profile"):
                 # collapsed-stack host profile
                 # (docs/observability.md "Host profiler"):
@@ -969,6 +1089,12 @@ def _make_handler(server: ScanServer):
                 return
             if self.path.split("?", 1)[0] == "/k8s/admission":
                 self._handle_admission(body)
+                return
+            if self.path.split("?", 1)[0] == "/prefetch":
+                # drain-handoff adoption (docs/serving.md "Elastic
+                # lifecycle"): book the migrating working set; the
+                # payloads live in the shared memo tier
+                self._reply(200, server.prefetch(body))
                 return
             from ..sched import DeadlineExceeded, SchedulerClosed
             try:

@@ -263,13 +263,19 @@ class SoakRunner:
         from ..router.scaler import (SimReplicaController,
                                      SubprocessReplicaController)
         ROUTER_METRICS.reset()
+        # elastic lifecycle (docs/serving.md): every replica shares
+        # one memo-tier directory, so scale_up steps exercise the
+        # real prewarm walk (join warming, stage owned ranges, flip
+        # ready) and scale_down steps run the drain handoff
+        memo_dir = self._tmpdir + "/memo"
         if self.mode == "inproc":
             self.controller = SimReplicaController(
                 prefix="soak",
                 service_ms=self.service_ms,
                 max_concurrent=self.max_concurrent,
                 seed=self.scenario.spec.seed,
-                slo_availability=self.slo_availability)
+                slo_availability=self.slo_availability,
+                memo_dir=memo_dir)
         else:
             self.controller = SubprocessReplicaController(
                 prefix="soak", extra_args=[
@@ -277,7 +283,8 @@ class SoakRunner:
                     "--max-concurrent", str(self.max_concurrent),
                     "--seed", str(self.scenario.spec.seed),
                     "--slo-availability",
-                    str(self.slo_availability)])
+                    str(self.slo_availability),
+                    "--memo-dir", memo_dir])
         self.router = ScanRouter(token=self.token)
         for _ in range(self.n_replicas):
             name, url = self.controller.start()
@@ -452,8 +459,15 @@ class SoakRunner:
         self._waiters.append(t)
 
     def _do_scale_up(self) -> None:
-        name, url = self.controller.start()
-        self.router.add_replica(name, url)
+        # the real join lifecycle: the new replica gets the current
+        # ring membership, computes its post-join ranges, prewarms
+        # out of the shared memo tier, and joins the ring WARMING —
+        # the prober admits it when its /healthz flips ready
+        members = self._routable_names()
+        name, url = self.controller.start(ring_members=members)
+        self.router.add_replica(
+            name, url,
+            warming=bool(self.controller.prewarm_enabled))
         ROUTER_METRICS.inc("scale_ups")
         with self._lock:
             self.counters["scale_ups"] += 1
@@ -470,6 +484,11 @@ class SoakRunner:
         ROUTER_METRICS.inc("drains_started")
         with self._lock:
             self.counters["scale_downs"] += 1
+        # drain handoff: hand the victim's hot-digest set to its
+        # ring successors while its in-flight work finishes —
+        # best-effort, never blocks the drain
+        from ..router.lifecycle import run_handoff
+        run_handoff(self.router, victim, timeout_s=2.0)
 
         def quiesce():
             deadline = time.monotonic() + 30.0
@@ -784,6 +803,7 @@ class SoakRunner:
             "trips_exact": trip["trips_exact"],
             "audit_ok": audit_v["ok"],
         }
+        from ..router.lifecycle import LIFECYCLE_METRICS
         return {
             "schema": REPORT_SCHEMA,
             "stable": stable,
@@ -808,7 +828,11 @@ class SoakRunner:
             "fleet": {"mode": self.mode,
                       "replicas_start": self.n_replicas,
                       "replicas_end": len(replica_rows),
-                      "replicas": replica_rows},
+                      "replicas": replica_rows,
+                      # handoff counters booked by THIS process's
+                      # run_handoff; per-replica prewarm counters
+                      # ride the replica rows above
+                      "lifecycle": LIFECYCLE_METRICS.snapshot()},
             "timeline": merged.report(),
             "wall": {"started_unix": round(wall_start, 3),
                      "duration_s": round(wall_s, 3)},
