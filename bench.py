@@ -3188,6 +3188,89 @@ def _storm_baseline_ips(replicas: int, service_ms: float,
         ROUTER_METRICS.reset()
 
 
+def bench_cost() -> dict:
+    """Cost-metering gates (docs/observability.md "Cost attribution
+    & goodput") on the 512-image warm fleet through the scheduler:
+
+    * **overhead** — the per-dispatch ledger bookkeeping must cost
+      < 1% images/s against the identical run with the ledger
+      disabled (``COST_LEDGER.enabled``), interleaved best-of-3 per
+      arm because the tunnel's run-to-run variance is the size of
+      the effect being gated;
+    * **balance** — the accounting identity: per-tenant attributed
+      device-seconds reconcile with the scheduler's measured
+      per-dispatch device-time integral within ±2%
+      (obs/cost.py:balance).
+    """
+    import os
+    import tempfile
+
+    from trivy_tpu.obs.cost import COST_LEDGER
+    from trivy_tpu.runtime import BatchScanRunner
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = make_fleet(tmp, N_IMAGES)
+        store = make_store()
+
+        def run_once(enabled: bool):
+            COST_LEDGER.reset()
+            COST_LEDGER.enabled = enabled
+            runner = BatchScanRunner(store=store, backend="tpu",
+                                     sched=_sched_cfg())
+            try:
+                t0 = time.perf_counter()
+                runner.scan_paths(paths)
+                dt = time.perf_counter() - t0
+                stats = dict(runner.last_stats.get("sched") or {})
+            finally:
+                runner.close()
+            return dt, stats
+
+        try:
+            # warm-up at the full fleet shape (per-shape compile
+            # stays outside every timed arm)
+            run_once(True)
+
+            off_s = on_s = float("inf")
+            on_cost: dict = {}
+            for _ in range(3):
+                dt, _ = run_once(False)
+                off_s = min(off_s, dt)
+                dt, stats = run_once(True)
+                if dt < on_s:
+                    on_s = dt
+                    on_cost = stats.get("cost") or {}
+        finally:
+            COST_LEDGER.enabled = True
+            COST_LEDGER.reset()
+
+        off_ips = N_IMAGES / off_s
+        on_ips = N_IMAGES / on_s
+        overhead = max(0.0, (off_ips - on_ips) / off_ips)
+
+        cap = float(os.environ.get("COST_GATE_OVERHEAD", "0.01"))
+        if os.environ.get("COST_GATE", "on") != "off":
+            assert overhead <= cap, \
+                f"cost metering overhead regressed: " \
+                f"{off_ips:.2f} ips unmetered vs {on_ips:.2f} " \
+                f"metered ({overhead:.2%} > cap {cap:.0%})"
+
+        bal = (on_cost.get("balance") or {})
+        assert bal.get("balanced"), \
+            f"cost books do not balance on the warm bench: {bal}"
+        return {
+            "images": N_IMAGES,
+            "ips_unmetered": round(off_ips, 2),
+            "ips_metered": round(on_ips, 2),
+            "overhead_frac": round(overhead, 4),
+            "overhead_cap": cap,
+            "balance": bal,
+            "charges": on_cost.get("charges", 0),
+            "tenants": sorted((on_cost.get("tenants")
+                               or {}).keys()),
+        }
+
+
 def bench_soak_smoke() -> dict:
     """Minutes-scale soak gate (docs/robustness.md "Soak & chaos
     testing") — the harness exercising itself on every PR:
@@ -3298,6 +3381,7 @@ def _run_config(cfg: str) -> dict:
             "router": bench_router,
             "soak-smoke": bench_soak_smoke,
             "soak": bench_soak,
+            "cost": bench_cost,
             "impact": bench_impact}[cfg]()
 
 
@@ -3353,6 +3437,7 @@ def main() -> None:
     witness = _subprocess_config("witness")
     router = _subprocess_config("router")
     impact = _subprocess_config("impact")
+    cost = _subprocess_config("cost")
     # the minutes-scale soak gate rides the default sweep; the full
     # compressed-week soak stays opt-in (--config soak)
     soak_smoke = _subprocess_config("soak-smoke")
@@ -3389,6 +3474,7 @@ def main() -> None:
         "witness": witness,
         "router": router,
         "impact": impact,
+        "cost": cost,
         "soak_smoke": soak_smoke,
     }))
 

@@ -1,0 +1,549 @@
+"""Cost attribution & goodput metering tests (trivy_tpu.obs.cost;
+docs/observability.md "Cost attribution & goodput").
+
+``pytest -m cost`` runs: the per-tenant ledger units (vector
+booking, top-K+other fold, windowed buckets, budget grammar), the
+BOOKS-BALANCE property through a live scheduler (per-tenant
+attributed device-seconds reconcile with the measured per-dispatch
+device-time integral, through memo-free and failure-free paths
+alike), the federation merge and the partial-answer ``/costs``
+rollup (fetch-injectable — one peer down means ``complete: false``,
+never an error), budget admission (throttle 429 and the
+deprioritize floor), the ``kind=efficiency`` SLO, the fail-closed
+tenant-label lint, the flight-recorder dump-dir byte cap, and the
+cost families' Prometheus exposition."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from trivy_tpu.obs.cost import (COST_LEDGER, MAX_COST_TENANTS,
+                                VECTOR_KEYS, CostLedger,
+                                TenantBudget, balance,
+                                device_seconds, federated_costs,
+                                merge_cost_exports,
+                                parse_budget_config)
+
+pytestmark = pytest.mark.cost
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_ledger():
+    """The process singleton is shared with every other suite —
+    leave it the way we found it."""
+    COST_LEDGER.reset()
+    COST_LEDGER.enabled = True
+    yield
+    COST_LEDGER.reset()
+    COST_LEDGER.enabled = True
+
+
+# ---------------------------------------------------------------
+# ledger units
+# ---------------------------------------------------------------
+
+class TestLedger:
+    def test_charge_accumulates_and_snapshot_totals(self):
+        led = CostLedger()
+        led.charge("alice", device_interval_s=0.2, bytes_in=100)
+        led.charge("alice", device_dfa_s=0.1, requests=1)
+        led.charge("bob", device_interval_s=0.3)
+        snap = led.snapshot()
+        a = snap["tenants"]["alice"]
+        assert a["device_interval_s"] == pytest.approx(0.2)
+        assert a["device_dfa_s"] == pytest.approx(0.1)
+        assert a["bytes_in"] == 100 and a["requests"] == 1
+        assert snap["device_s"] == pytest.approx(0.6)
+        assert snap["totals"]["device_interval_s"] == \
+            pytest.approx(0.5)
+        assert snap["charges"] == 3
+
+    def test_unknown_vector_key_raises(self):
+        led = CostLedger()
+        with pytest.raises(ValueError, match="unknown cost vector"):
+            led.charge("alice", device_intervall_s=1.0)
+
+    def test_topk_other_fold(self):
+        led = CostLedger(max_tenants=2)
+        for i in range(5):
+            led.charge(f"t{i}", requests=1)
+        snap = led.snapshot()
+        assert set(snap["tenants"]) == {"t0", "t1", "other"}
+        assert snap["tenants"]["other"]["requests"] == 3
+        # fleet-wide total survives the fold
+        assert snap["totals"]["requests"] == 5
+
+    def test_disabled_books_nothing(self):
+        led = CostLedger()
+        led.enabled = False
+        led.charge("alice", requests=1)
+        assert led.snapshot()["tenants"] == {}
+        assert led.charges == 0
+
+    def test_windowed_spend_ages_out(self):
+        clock = [100.0]
+        led = CostLedger(clock=lambda: clock[0])
+        led.charge("alice", device_interval_s=1.0)
+        assert led.window_device_s("alice", 60.0) == \
+            pytest.approx(1.0)
+        clock[0] += 120.0                  # past the 60 s window
+        assert led.window_device_s("alice", 60.0) == 0.0
+        # cumulative book never forgets
+        assert led.snapshot()["device_s"] == pytest.approx(1.0)
+
+    def test_aot_amortized_by_device_share(self):
+        led = CostLedger()
+        led.charge("alice", device_interval_s=3.0)
+        led.charge("bob", device_dfa_s=1.0)
+        snap = led.snapshot(aot_compile_s=8.0)
+        assert snap["tenants"]["alice"]["aot_amortized_s"] == \
+            pytest.approx(6.0)
+        assert snap["tenants"]["bob"]["aot_amortized_s"] == \
+            pytest.approx(2.0)
+
+    def test_export_is_age_keyed(self):
+        clock = [1000.0]
+        led = CostLedger(clock=lambda: clock[0])
+        led.charge("alice", requests=1)
+        clock[0] += 30.0                   # three buckets later
+        led.charge("alice", requests=1)
+        exp = led.export_state()
+        assert set(exp["buckets"]) == {"0", "3"}
+        assert exp["cum"]["alice"]["requests"] == 2
+
+
+# ---------------------------------------------------------------
+# budget grammar
+# ---------------------------------------------------------------
+
+class TestBudgetGrammar:
+    def test_inline_parse(self):
+        b = parse_budget_config(
+            "alice:device_s=2.5,window_s=30,action=deprioritize,"
+            "floor=-5;bob:device_s=1")
+        assert b["alice"] == TenantBudget(
+            tenant="alice", device_s=2.5, window_s=30.0,
+            action="deprioritize", floor=-5)
+        assert b["bob"].device_s == 1.0
+        assert b["bob"].action == "throttle"
+
+    def test_json_file_parse(self, tmp_path):
+        p = tmp_path / "budgets.json"
+        p.write_text('{"alice": {"device_s": 2.0, '
+                     '"window_s": 60}}')
+        b = parse_budget_config(str(p))
+        assert b["alice"].device_s == 2.0
+
+    @pytest.mark.parametrize("bad", [
+        "alice:devise_s=1,window_s=60",    # typo'd key
+        "alice:window_s=60",               # missing device_s
+        "alice:device_s=0",                # non-positive allowance
+        "alice:device_s=1,action=evict",   # unknown action
+        "alice",                           # no settings at all
+    ])
+    def test_malformed_fails_up_front(self, bad):
+        with pytest.raises(ValueError):
+            parse_budget_config(bad)
+
+
+# ---------------------------------------------------------------
+# federation merge + balance verdict
+# ---------------------------------------------------------------
+
+class TestMergeAndBalance:
+    def _export(self, tenant, dev, age="0"):
+        vec = dict.fromkeys(VECTOR_KEYS, 0.0)
+        vec["device_interval_s"] = dev
+        return {"schema": 1, "bucket_s": 10.0,
+                "cum": {tenant: dict(vec)},
+                "buckets": {age: {tenant: dict(vec)}}}
+
+    def test_merge_sums_by_tenant_and_age(self):
+        m = merge_cost_exports([self._export("alice", 1.0),
+                                self._export("alice", 2.0),
+                                self._export("bob", 4.0, age="2")])
+        assert m["cum"]["alice"]["device_interval_s"] == \
+            pytest.approx(3.0)
+        assert m["buckets"]["0"]["alice"]["device_interval_s"] \
+            == pytest.approx(3.0)
+        assert m["buckets"]["2"]["bob"]["device_interval_s"] == \
+            pytest.approx(4.0)
+
+    def test_merge_drops_malformed_never_raises(self):
+        m = merge_cost_exports([
+            None, 42, {"cum": {"a": "nope"},
+                       "buckets": {"x": 3, "0": {"b": None}}},
+            self._export("alice", 1.0)])
+        assert set(m["cum"]) == {"alice"}
+
+    def test_merge_folds_past_fleet_cap(self):
+        exports = [self._export(f"t{i}", 1.0)
+                   for i in range(MAX_COST_TENANTS + 8)]
+        m = merge_cost_exports(exports)
+        # top-K + one shared overflow row
+        assert len(m["cum"]) == MAX_COST_TENANTS + 1
+        assert "other" in m["cum"]
+        assert m["cum"]["other"]["device_interval_s"] == \
+            pytest.approx(8.0)
+        total = sum(device_seconds(v) for v in m["cum"].values())
+        assert total == pytest.approx(MAX_COST_TENANTS + 8)
+
+    def test_balance_verdicts(self):
+        assert balance(1.0, 1.01)["balanced"]
+        bad = balance(1.0, 1.5)
+        assert not bad["balanced"] and bad["skew"] > 0.3
+        # tiny books are vacuously balanced
+        assert balance(0.0, 0.0)["balanced"]
+        assert balance(0.0005, 0.0)["balanced"]
+
+
+class TestFederatedCosts:
+    def _answer(self, tenant, dev, measured):
+        vec = dict.fromkeys(VECTOR_KEYS, 0.0)
+        vec["device_interval_s"] = dev
+        return {"export": {"schema": 1, "bucket_s": 10.0,
+                           "cum": {tenant: vec}, "buckets": {}},
+                "measured_device_s": measured, "complete": True}
+
+    def test_all_up_sums_and_balances(self):
+        answers = {"http://a": self._answer("alice", 1.0, 1.0),
+                   "http://b": self._answer("bob", 2.0, 2.0)}
+        out = federated_costs([("a", "http://a"), ("b", "http://b")],
+                              fetch=lambda u: answers[u])
+        assert out["complete"]
+        assert out["tenants"]["alice"]["device_s"] == \
+            pytest.approx(1.0)
+        assert out["attributed_device_s"] == pytest.approx(3.0)
+        assert out["measured_device_s"] == pytest.approx(3.0)
+        assert out["balance"]["balanced"]
+
+    def test_down_peer_partial_answer_never_raises(self):
+        def fetch(url):
+            if url == "http://dead":
+                raise OSError("connection refused")
+            return self._answer("alice", 1.0, 1.0)
+        out = federated_costs(
+            [("up", "http://up"), ("dead", "http://dead")],
+            fetch=fetch)
+        assert not out["complete"]
+        rows = {r["replica"]: r for r in out["replicas"]}
+        assert rows["up"]["up"] and not rows["dead"]["up"]
+        assert "connection refused" in rows["dead"]["error"]
+        # the surviving replica's books still answer
+        assert out["tenants"]["alice"]["device_s"] == \
+            pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------
+# the books-balance property through a LIVE scheduler
+# ---------------------------------------------------------------
+
+class TestBooksBalanceProperty:
+    def _run_fleet(self, n=24, fail_every=0):
+        from trivy_tpu.sched import (AnalyzedWork, ScanRequest,
+                                     ScanScheduler, SchedConfig)
+        sched = ScanScheduler(config=SchedConfig(
+            workers=4, flush_timeout_s=0.02))
+        tenants = ("alice", "bob", "carol")
+        try:
+            reqs = []
+            for i in range(n):
+                def analyze(req, i=i):
+                    if fail_every and i % fail_every == 0:
+                        raise RuntimeError("synthetic analyze bug")
+                    return AnalyzedWork(
+                        finish=lambda f, d, i=i: f"r{i}")
+                reqs.append(sched.submit(ScanRequest(
+                    f"r{i}", analyze,
+                    tenant=tenants[i % len(tenants)])))
+            for r in reqs:
+                try:
+                    r.result(timeout=30)
+                except Exception:        # noqa: BLE001 — the
+                    # property is about the books, not the verdict
+                    pass
+            return sched.cost_snapshot()
+        finally:
+            sched.close()
+
+    def test_attributed_equals_measured_integral(self):
+        cost = self._run_fleet(n=24)
+        bal = cost["balance"]
+        assert bal["balanced"], bal
+        # every completed request was billed to its tenant
+        assert cost["totals"]["requests"] == 24
+        assert set(cost["tenants"]) >= {"alice", "bob", "carol"}
+
+    def test_identity_survives_analyze_failures(self):
+        cost = self._run_fleet(n=24, fail_every=4)
+        assert cost["balance"]["balanced"], cost["balance"]
+
+    def test_identity_survives_concurrent_charges(self):
+        led = CostLedger()
+        def worker(t):
+            for _ in range(500):
+                led.charge(t, device_interval_s=0.001)
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in ("alice", "bob", "carol", "dave")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = led.snapshot()
+        assert snap["device_s"] == pytest.approx(4 * 0.5)
+        assert snap["charges"] == 2000
+
+
+# ---------------------------------------------------------------
+# budget admission: throttle 429 and the deprioritize floor
+# ---------------------------------------------------------------
+
+class TestBudgetAdmission:
+    def _sched(self, budgets):
+        from trivy_tpu.sched import ScanScheduler, SchedConfig
+        return ScanScheduler(config=SchedConfig(
+            workers=2, flush_timeout_s=0.02, budgets=budgets))
+
+    def test_over_budget_throttles_with_retry_after(self):
+        from trivy_tpu.sched import (AnalyzedWork, RateLimitedError,
+                                     ScanRequest)
+        COST_LEDGER.charge("alice", device_interval_s=5.0)
+        sched = self._sched("alice:device_s=1,window_s=60")
+        try:
+            with pytest.raises(RateLimitedError) as ei:
+                sched.submit(ScanRequest(
+                    "r0", lambda r: AnalyzedWork(
+                        finish=lambda f, d: "r0"),
+                    tenant="alice"))
+            assert ei.value.retry_after_s >= 1.0
+            assert "budget" in str(ei.value)
+            # the shed is booked on the offender
+            snap = sched.queue.book.snapshot()
+            assert snap["alice"]["counters"][
+                "rejected_budget"] == 1
+            assert snap["alice"]["shed"] == 1
+        finally:
+            sched.close()
+
+    def test_under_budget_admits(self):
+        from trivy_tpu.sched import AnalyzedWork, ScanRequest
+        sched = self._sched("alice:device_s=1,window_s=60")
+        try:
+            req = sched.submit(ScanRequest(
+                "r0", lambda r: AnalyzedWork(
+                    finish=lambda f, d: "ok"),
+                tenant="alice"))
+            assert req.result(timeout=10) == "ok"
+        finally:
+            sched.close()
+
+    def test_deprioritize_clamps_to_floor(self):
+        from trivy_tpu.sched import AnalyzedWork, ScanRequest
+        COST_LEDGER.charge("alice", device_interval_s=5.0)
+        sched = self._sched(
+            "alice:device_s=1,window_s=60,"
+            "action=deprioritize,floor=-7")
+        try:
+            req = sched.submit(ScanRequest(
+                "r0", lambda r: AnalyzedWork(
+                    finish=lambda f, d: "ok"),
+                tenant="alice", priority=10))
+            assert req.priority == -7
+            assert req.result(timeout=10) == "ok"
+        finally:
+            sched.close()
+
+    def test_unbudgeted_tenant_unaffected(self):
+        from trivy_tpu.sched import AnalyzedWork, ScanRequest
+        COST_LEDGER.charge("alice", device_interval_s=5.0)
+        sched = self._sched("alice:device_s=1,window_s=60")
+        try:
+            req = sched.submit(ScanRequest(
+                "r0", lambda r: AnalyzedWork(
+                    finish=lambda f, d: "ok"),
+                tenant="bob"))
+            assert req.result(timeout=10) == "ok"
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------
+# the efficiency SLO kind (MFU-style goodput gauge)
+# ---------------------------------------------------------------
+
+class TestEfficiencySlo:
+    def test_parse_grammar(self):
+        from trivy_tpu.obs.slo import parse_slo_config
+        slos = parse_slo_config(
+            "goodput:kind=efficiency,objective=0.7")
+        assert len(slos) == 1
+        assert slos[0].kind == "efficiency"
+        assert slos[0].objective == 0.7
+
+    def test_useful_share_gauges_and_trips(self):
+        from trivy_tpu.obs.slo import SloEngine, parse_slo_config
+        eng = SloEngine(slos=parse_slo_config(
+            "goodput:kind=efficiency,objective=0.7"))
+        eng.record_device(0.9, idle_s=0.1)
+        (v,) = eng.verdicts()
+        assert v["kind"] == "efficiency" and v["ok"]
+        assert v["efficiency"] == pytest.approx(0.9)
+        waste = SloEngine(slos=parse_slo_config(
+            "goodput:kind=efficiency,objective=0.7"))
+        waste.record_device(0.1, idle_s=0.9)
+        (v,) = waste.verdicts()
+        assert not v["ok"]
+        assert v["efficiency"] == pytest.approx(0.1)
+
+    def test_federates_like_any_other_kind(self):
+        from trivy_tpu.obs.slo import (SloEngine, merge_exports,
+                                       parse_slo_config,
+                                       verdicts_from_export)
+        spec = "goodput:kind=efficiency,objective=0.5"
+        a = SloEngine(slos=parse_slo_config(spec))
+        b = SloEngine(slos=parse_slo_config(spec))
+        a.record_device(0.9, idle_s=0.1)
+        b.record_device(0.1, idle_s=0.9)
+        merged = merge_exports(
+            [a.export_state(), b.export_state()])
+        (v,) = verdicts_from_export(merged)
+        assert v["efficiency"] == pytest.approx(0.5, abs=0.01)
+
+
+# ---------------------------------------------------------------
+# fail-closed tenant-label lint (analysis/rules.py)
+# ---------------------------------------------------------------
+
+class TestTenantLabelLintFailClosed:
+    TENANT_OPEN = (
+        "import threading\n"
+        "class BookMetrics:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._c = {}\n"
+        "    def inc(self, tenant):\n"
+        "        with self._lock:\n"
+        "            self._c[tenant] = self._c.get(tenant, 0) + 1\n"
+        "    def cap_elsewhere(self, tenant):\n"
+        "        if tenant not in self._c and len(self._c) >= 64:\n"
+        "            tenant = 'other'\n"
+        "    def snapshot(self):\n"
+        "        return dict(self._c)\n")
+
+    def _findings(self, src):
+        from trivy_tpu.analysis import analyze_source
+        return [f for f in analyze_source(src).findings
+                if f.rule == "unbounded-label-cardinality"]
+
+    def test_tenant_key_requires_fold_in_same_function(self):
+        # a cap in ANOTHER method does not excuse a tenant-keyed
+        # insert: the rule fails closed for tenant params
+        fs = self._findings(self.TENANT_OPEN)
+        assert len(fs) == 1
+        assert "tenant" in fs[0].message
+
+    def test_fold_in_function_is_clean(self):
+        capped = self.TENANT_OPEN.replace(
+            "        with self._lock:\n",
+            "        if tenant not in self._c and "
+            "len(self._c) >= 64:\n"
+            "            tenant = 'other'\n"
+            "        with self._lock:\n")
+        assert self._findings(capped) == []
+
+    def test_whole_tree_honors_the_rule(self):
+        from trivy_tpu.analysis import analyze_tree
+        rep = analyze_tree()
+        assert rep.ok, "\n" + rep.text()
+
+
+# ---------------------------------------------------------------
+# flight-recorder dump-dir byte cap (TRIVY_TPU_DUMP_MAX_BYTES)
+# ---------------------------------------------------------------
+
+class TestRecorderByteCap:
+    def _dump_n(self, rec, n):
+        import os
+        for i in range(n):
+            tid = f"{i:02x}" * 16
+            rec.add(tid, [])
+            rec.dump(tid)
+        return sum(os.path.getsize(os.path.join(rec.dump_dir, f))
+                   for f in os.listdir(rec.dump_dir))
+
+    def test_byte_cap_rotates_oldest_first(self, tmp_path,
+                                           monkeypatch):
+        import os
+
+        from trivy_tpu.obs.recorder import (DUMP_MAX_BYTES_ENV,
+                                            FlightRecorder)
+        probe = FlightRecorder(dump_dir=str(tmp_path / "probe"))
+        one = self._dump_n(probe, 1)
+        monkeypatch.setenv(DUMP_MAX_BYTES_ENV, str(int(2.5 * one)))
+        rec = FlightRecorder(dump_dir=str(tmp_path / "capped"))
+        self._dump_n(rec, 6)
+        st = rec.stats()
+        assert st["dump_bytes"] <= 2.5 * one
+        assert st["dumps_pruned"] >= 3
+        names = sorted(os.listdir(rec.dump_dir))
+        # the freshest evidence is never the one rotated away
+        assert any(f"{5:02x}" * 16 in n for n in names)
+        assert st["dump_bytes"] == sum(
+            os.path.getsize(os.path.join(rec.dump_dir, f))
+            for f in os.listdir(rec.dump_dir))
+
+    def test_cap_off_by_default(self, tmp_path, monkeypatch):
+        from trivy_tpu.obs.recorder import (DUMP_MAX_BYTES_ENV,
+                                            FlightRecorder)
+        monkeypatch.delenv(DUMP_MAX_BYTES_ENV, raising=False)
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        self._dump_n(rec, 5)
+        assert rec.stats()["dump_files"] == 5
+        assert rec.stats()["dumps_pruned"] == 0
+
+
+# ---------------------------------------------------------------
+# prom exposition of the cost families
+# ---------------------------------------------------------------
+
+class TestCostExposition:
+    def _stats(self):
+        led = CostLedger()
+        led.charge("alice", device_interval_s=1.5,
+                   device_dfa_s=0.5, host_analyze_s=0.2,
+                   bytes_in=1000, memo_hits=3, requests=4)
+        cost = led.snapshot(aot_compile_s=2.0)
+        cost["measured_device_s"] = 2.0
+        cost["balance"] = balance(2.0, 2.0)
+        return {"counters": {"completed": 4}, "cost": cost}
+
+    def test_families_render_with_tenant_labels(self):
+        from trivy_tpu.obs.prom import render_prometheus
+        text = render_prometheus(self._stats())
+        assert 'trivy_tpu_cost_device_seconds_total' \
+            '{tenant="alice",kernel="interval"} 1.5' in text
+        assert 'trivy_tpu_cost_device_seconds_total' \
+            '{tenant="alice",kernel="dfa"} 0.5' in text
+        assert 'trivy_tpu_cost_host_seconds_total' \
+            '{tenant="alice",phase="analyze"} 0.2' in text
+        assert 'trivy_tpu_cost_bytes_in_total' \
+            '{tenant="alice"} 1000' in text
+        assert 'trivy_tpu_cost_events_total' \
+            '{tenant="alice",event="memo_hits"} 3' in text
+        assert 'trivy_tpu_cost_aot_amortized_seconds' \
+            '{tenant="alice"} 2' in text
+        assert "trivy_tpu_cost_attributed_device_seconds 2" in text
+        assert "trivy_tpu_cost_measured_device_seconds 2" in text
+        assert "trivy_tpu_cost_balanced 1" in text
+
+    def test_latency_exemplars_carry_trace_ids(self):
+        from trivy_tpu.obs.prom import render_prometheus
+        from trivy_tpu.sched.metrics import SchedMetrics
+        m = SchedMetrics()
+        m.observe("device", 0.25, trace_id="ab" * 16)
+        text = render_prometheus(
+            {"counters": {"completed": 1}},
+            phase_hists=m.hist_snapshot(), openmetrics=True)
+        assert '# {trace_id="' + "ab" * 16 + '"}' in text

@@ -288,10 +288,13 @@ class TestProcessGauges:
     def test_procstats_shape(self):
         from trivy_tpu.obs.procstats import process_self_stats
         st = process_self_stats()
-        assert set(st) == {"rss_bytes", "open_fds", "threads"}
+        assert set(st) == {"rss_bytes", "peak_rss_bytes",
+                           "open_fds", "threads"}
         assert st["threads"] >= 1
         # on Linux /proc/self is live; elsewhere -1 sentinels
         assert st["rss_bytes"] == -1 or st["rss_bytes"] > 0
+        # the peak ratchet never reads below the live gauge
+        assert st["peak_rss_bytes"] >= st["rss_bytes"]
 
     def test_render_prometheus_carries_gauges(self):
         from trivy_tpu.obs.prom import render_prometheus
@@ -431,6 +434,25 @@ class TestSoakRunnerE2E:
         assert st["books_balanced"]
         w = report["books"]["watch"]
         assert w["events"] == w["scans"] + w["deduped"] + w["shed"]
+
+    def test_invoice_balances_through_chaos(self, report):
+        # the per-tenant invoice rides the verdict: its totals
+        # equal the fleet ledger, and the accounting identity
+        # holds through the kill + scale_up + hot_swap chaos
+        assert report["stable"]["invoice_totals_match"]
+        inv = report["costs"]
+        assert inv["balance"]["balanced"], inv["balance"]
+        assert inv["tenants"], "no tenant was ever billed"
+        tenant_sum = sum(v["device_s"]
+                         for v in inv["tenants"].values())
+        assert tenant_sum == pytest.approx(
+            inv["attributed_device_s"], rel=1e-3)
+
+    def test_peak_rss_in_verdict(self, report):
+        assert report["fleet"]["peak_rss_bytes"] > 0
+        series = report["audit"]["series"]
+        assert "replica_peak_rss_bytes" in series
+        assert not series["replica_peak_rss_bytes"]["gated"]
 
     def test_designed_trip_exact_with_evidence(self, report):
         trip = report["slo"]["trip"]

@@ -819,15 +819,25 @@ class LabelCardinalityRule(Rule):
 
     name = "unbounded-label-cardinality"
     summary = ("Open-keyed metric/label dicts need a cardinality "
-               "cap or overflow fold (PR-7/PR-8).")
+               "cap or overflow fold (PR-7/PR-8); tenant-keyed "
+               "inserts need the fold in the same function.")
+
+    # tenant labels are held to a STRICTER, fail-closed standard
+    # (the cost plane ships tenant-keyed invoice books): an insert
+    # keyed by a tenant-named parameter must show the top-K +
+    # "other" fold evidence in the SAME function - cap evidence
+    # elsewhere in the class does not count, because a refactor
+    # that moves the capped path away silently unbounds the label
+    _TENANT_PARAM = re.compile(r"^tenant(_id|_name)?$")
 
     def check(self, mi: ModuleInfo,
               ctx: dict) -> Iterable[Finding]:
         for node in mi.tree.body:
             if not isinstance(node, ast.ClassDef):
                 continue
-            if not self._metricsy(node) or self._has_cap(node):
+            if not self._metricsy(node):
                 continue
+            class_cap = self._has_cap(node)
             for fn in node.body:
                 if not isinstance(
                         fn,
@@ -835,14 +845,28 @@ class LabelCardinalityRule(Rule):
                     continue
                 params = {a.arg for a in fn.args.args
                           if a.arg != "self"}
-                for site in self._open_inserts(fn, params):
-                    yield Finding(
-                        self.name, mi.rel, site,
-                        f"{node.name} inserts parameter-keyed "
-                        "entries into a label/counter dict with "
-                        "no cardinality cap or overflow fold — "
-                        "an open key domain becomes an unbounded "
-                        "prom label set (PR-7/PR-8 class)")
+                fn_cap = self._fn_has_cap(fn)
+                for site, key in self._open_inserts(fn, params):
+                    if self._TENANT_PARAM.match(key):
+                        if not fn_cap:
+                            yield Finding(
+                                self.name, mi.rel, site,
+                                f"{node.name} books a tenant-"
+                                "labeled series with no top-K + "
+                                "\"other\" fold in this function "
+                                "— tenant cardinality checks "
+                                "fail closed: the fold must be "
+                                "visible at the insert site "
+                                "(PR-7/PR-8, cost-plane rule)")
+                    elif not class_cap:
+                        yield Finding(
+                            self.name, mi.rel, site,
+                            f"{node.name} inserts parameter-keyed "
+                            "entries into a label/counter dict "
+                            "with no cardinality cap or overflow "
+                            "fold — an open key domain becomes an "
+                            "unbounded prom label set (PR-7/PR-8 "
+                            "class)")
 
     @staticmethod
     def _metricsy(node: ast.ClassDef) -> bool:
@@ -853,7 +877,7 @@ class LabelCardinalityRule(Rule):
                    for f in node.body)
 
     @staticmethod
-    def _has_cap(node: ast.ClassDef) -> bool:
+    def _has_cap(node) -> bool:
         for sub in ast.walk(node):
             if isinstance(sub, ast.Constant) and \
                     isinstance(sub.value, str) and \
@@ -866,21 +890,28 @@ class LabelCardinalityRule(Rule):
                         return True
         return False
 
+    @classmethod
+    def _fn_has_cap(cls, fn) -> bool:
+        # the same cap/fold evidence, scoped to ONE function — the
+        # fail-closed bar a tenant-keyed insert must clear
+        return cls._has_cap(fn)
+
     @staticmethod
     def _open_inserts(fn, params: set):
+        """Yields ``(lineno, key_param_name)`` per open insert."""
         for sub in ast.walk(fn):
             if isinstance(sub, ast.Assign):
                 for t in sub.targets:
                     if isinstance(t, ast.Subscript) and \
                             isinstance(t.slice, ast.Name) and \
                             t.slice.id in params:
-                        yield sub.lineno
+                        yield sub.lineno, t.slice.id
             elif isinstance(sub, ast.Call) and \
                     isinstance(sub.func, ast.Attribute) and \
                     sub.func.attr == "setdefault" and sub.args \
                     and isinstance(sub.args[0], ast.Name) and \
                     sub.args[0].id in params:
-                yield sub.lineno
+                yield sub.lineno, sub.args[0].id
 
 
 def default_rules() -> list:

@@ -220,6 +220,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "— per-tenant WFQ weights, max_queued/"
                         "max_inflight quotas, and token-bucket "
                         "rate/burst limits (429 + Retry-After)")
+        sp.add_argument("--tenant-budget", default="",
+                        help="per-tenant device-second budgets "
+                        "(docs/observability.md 'Cost attribution "
+                        "& goodput'): JSON file or inline "
+                        "'alice:device_s=2.5,window_s=60,"
+                        "action=throttle;bob:device_s=1' — a "
+                        "tenant over its windowed spend is "
+                        "throttled (429 + Retry-After) or "
+                        "deprioritized to the budget's priority "
+                        "floor")
         sp.add_argument("--fault-spec", default="",
                         help="inject deterministic faults "
                         "(docs/robustness.md): a scenario name "
@@ -476,6 +486,18 @@ def build_parser() -> argparse.ArgumentParser:
                      "from the Trivy-Tenant header or body field; "
                      "over-quota tenants get 429 + Retry-After "
                      "while compliant tenants' p99 holds")
+    srv.add_argument("--tenant-budget", default="",
+                     help="per-tenant device-second budgets "
+                     "(docs/observability.md 'Cost attribution & "
+                     "goodput'): JSON file or inline "
+                     "'alice:device_s=2.5,window_s=60,"
+                     "action=throttle;bob:device_s=1,"
+                     "action=deprioritize' — admission reads the "
+                     "tenant's windowed spend from the cost ledger "
+                     "(GET /costs); over budget means 429 + "
+                     "Retry-After (throttle) or a priority-floor "
+                     "clamp inside the tenant's own WFQ lane "
+                     "(deprioritize)")
     srv.add_argument("--sched-deadline", default="",
                      help="default per-request deadline "
                      "(Go duration, e.g. 30s; requests may "
@@ -1122,7 +1144,8 @@ def run_server(args) -> int:
         try:
             cfg = _sched_config(args)
         except ValueError as e:
-            print(f"error: --tenant-config: {e}", file=sys.stderr)
+            print(f"error: --tenant-config/--tenant-budget: "
+                  f"{e}", file=sys.stderr)
             return 2
         if getattr(args, "sched_deadline", ""):
             from .flag import parse_duration
@@ -1381,7 +1404,8 @@ def run_watch(args) -> int:
     try:
         sched_config = _sched_config(args)
     except ValueError as e:
-        print(f"error: --tenant-config: {e}", file=sys.stderr)
+        print(f"error: --tenant-config/--tenant-budget: {e}",
+              file=sys.stderr)
         return 2
     runner = BatchScanRunner(
         store=holder, cache=cache, backend=args.backend,
@@ -1989,6 +2013,12 @@ def _sched_config(args):
         # malformed QoS config silently granting unlimited service
         # is exactly the overload hole tenancy exists to close
         tenancy = parse_tenant_config(args.tenant_config)
+    budgets = None
+    if getattr(args, "tenant_budget", ""):
+        # same eager-validation contract: a typo'd budget silently
+        # metering nothing would defeat the admission gate
+        from .obs.cost import parse_budget_config
+        budgets = parse_budget_config(args.tenant_budget)
     return SchedConfig(
         max_queue=getattr(args, "sched_queue", 256),
         workers=getattr(args, "sched_workers", 4),
@@ -1996,7 +2026,8 @@ def _sched_config(args):
         / 1000.0,
         dispatch_depth=resolve_dispatch_depth(
             getattr(args, "dispatch_depth", 0) or 0),
-        tenancy=tenancy)
+        tenancy=tenancy,
+        budgets=budgets)
 
 
 def _init_multihost(args) -> int:
@@ -2068,7 +2099,8 @@ def _run_image_batch(args, targets: list) -> int:
     try:
         sched_config = _sched_config(args)
     except ValueError as e:
-        print(f"error: --tenant-config: {e}", file=sys.stderr)
+        print(f"error: --tenant-config/--tenant-budget: {e}",
+              file=sys.stderr)
         return 2
     rc = _init_multihost(args)
     if rc:

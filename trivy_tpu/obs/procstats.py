@@ -19,6 +19,21 @@ import threading
 
 _PAGE = 4096  # only used for the statm fallback
 
+# high-water RSS observed by THIS process's own sampling (ratcheted
+# on every process_self_stats call — the scrape cadence is the
+# sampling cadence). The soak gate "peak RSS bounded" reads this
+# through metrics federation instead of trusting whichever single
+# sample a prober happened to catch.
+_peak_lock = threading.Lock()
+_peak_rss = -1
+
+
+def reset_peak_rss() -> None:
+    """Forget the high-water mark (test isolation)."""
+    global _peak_rss
+    with _peak_lock:
+        _peak_rss = -1
+
 
 def _rss_bytes() -> int:
     """Resident set size from ``/proc/self/status`` (VmRSS), with a
@@ -53,15 +68,25 @@ def _open_fds() -> int:
 
 
 def process_self_stats() -> dict:
-    """One sample: ``{"rss_bytes", "open_fds", "threads"}``.
+    """One sample: ``{"rss_bytes", "open_fds", "threads",
+    "peak_rss_bytes"}``.
 
     ``threads`` comes from :func:`threading.active_count` — the
     interpreter's view, which is what leak hunting cares about
     (a native thread the interpreter lost track of shows up in RSS
-    instead). Unavailable gauges are ``-1`` so renderers and the
-    audit can tell "no data" from "zero"."""
+    instead). ``peak_rss_bytes`` is the ratcheted high-water of
+    every sample this process has taken — the federated soak gate's
+    "peak RSS bounded" series. Unavailable gauges are ``-1`` so
+    renderers and the audit can tell "no data" from "zero"."""
+    global _peak_rss
+    rss = _rss_bytes()
+    with _peak_lock:
+        if rss > _peak_rss:
+            _peak_rss = rss
+        peak = _peak_rss
     return {
-        "rss_bytes": _rss_bytes(),
+        "rss_bytes": rss,
         "open_fds": _open_fds(),
         "threads": threading.active_count(),
+        "peak_rss_bytes": peak,
     }

@@ -126,6 +126,9 @@ def _process_gauges(w: _Writer, proc: dict) -> None:
     for key, name, help_ in (
             ("rss_bytes", "rss_bytes",
              "Resident set size of this process (VmRSS)."),
+            ("peak_rss_bytes", "peak_rss_bytes",
+             "High-water RSS across every self-stat sample this "
+             "process has taken (the soak leak gate's series)."),
             ("open_fds", "open_fds",
              "Open file descriptors of this process."),
             ("threads", "threads",
@@ -575,6 +578,86 @@ def render_prometheus(stats: dict, phase_hists=None,
         w.scalar(f"{_PREFIX}_slo_dumps_total", "counter",
                  "Flight-recorder trace dumps triggered by burn-"
                  "rate trips.", slo.get("dumps"))
+        eff = [v for v in slo["slos"] if "efficiency" in v]
+        if eff:
+            name = f"{_PREFIX}_slo_efficiency"
+            w.header(name, "gauge",
+                     "Useful-device-time share over the recent "
+                     "window for kind=efficiency SLOs (MFU-style "
+                     "goodput).")
+            for v in eff:
+                w.sample(name, [("slo", v["name"])],
+                         v["efficiency"])
+
+    cost = stats.get("cost") or {}
+    if cost.get("tenants") or cost.get("charges"):
+        # per-tenant cost attribution (obs/cost.py,
+        # docs/observability.md "Cost attribution & goodput") —
+        # tenant rows are pre-folded to top-K + "other" by the
+        # ledger, so the label space is bounded by construction
+        ctenants = cost.get("tenants") or {}
+        name = f"{_PREFIX}_cost_device_seconds_total"
+        w.header(name, "counter",
+                 "Attributed device-seconds by tenant and kernel "
+                 "family (interval bucket-ladder vs DFA sieve).")
+        for t in sorted(ctenants):
+            vec = ctenants[t]
+            w.sample(name, [("tenant", t),
+                            ("kernel", "interval")],
+                     vec.get("device_interval_s"))
+            w.sample(name, [("tenant", t), ("kernel", "dfa")],
+                     vec.get("device_dfa_s"))
+        name = f"{_PREFIX}_cost_host_seconds_total"
+        w.header(name, "counter",
+                 "Attributed host-seconds by tenant and phase.")
+        for t in sorted(ctenants):
+            vec = ctenants[t]
+            w.sample(name, [("tenant", t),
+                            ("phase", "analyze")],
+                     vec.get("host_analyze_s"))
+            w.sample(name, [("tenant", t), ("phase", "finish")],
+                     vec.get("host_finish_s"))
+        name = f"{_PREFIX}_cost_bytes_in_total"
+        w.header(name, "counter",
+                 "Candidate bytes ingested, per tenant.")
+        for t in sorted(ctenants):
+            w.sample(name, [("tenant", t)],
+                     ctenants[t].get("bytes_in"))
+        name = f"{_PREFIX}_cost_events_total"
+        w.header(name, "counter",
+                 "Per-tenant memo hit/miss and completed-request "
+                 "counts.")
+        for t in sorted(ctenants):
+            vec = ctenants[t]
+            for ev in ("memo_hits", "memo_misses", "requests"):
+                w.sample(name, [("tenant", t), ("event", ev)],
+                         vec.get(ev))
+        name = f"{_PREFIX}_cost_aot_amortized_seconds"
+        w.header(name, "gauge",
+                 "AOT compile wall amortized across tenants by "
+                 "device-second share.")
+        for t in sorted(ctenants):
+            w.sample(name, [("tenant", t)],
+                     ctenants[t].get("aot_amortized_s"))
+        w.scalar(f"{_PREFIX}_cost_attributed_device_seconds",
+                 "gauge",
+                 "Sum of per-tenant attributed device-seconds.",
+                 cost.get("device_s"))
+        w.scalar(f"{_PREFIX}_cost_measured_device_seconds",
+                 "gauge",
+                 "Measured per-dispatch device-time integral the "
+                 "attribution must reconcile against.",
+                 cost.get("measured_device_s"))
+        bal = cost.get("balance") or {}
+        if bal:
+            w.scalar(f"{_PREFIX}_cost_balanced", "gauge",
+                     "1 while attributed and measured device time "
+                     "agree within the tolerance (the accounting "
+                     "identity).",
+                     1 if bal.get("balanced") else 0)
+            w.scalar(f"{_PREFIX}_cost_balance_skew", "gauge",
+                     "Relative attributed-vs-measured skew.",
+                     bal.get("skew"))
 
     resident = stats.get("resident") or ()
     if resident:

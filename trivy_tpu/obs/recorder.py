@@ -30,6 +30,14 @@ import time
 # otherwise — a fleet of them is DUMP_CAP x N stale evidence
 DUMP_MAX_AGE_ENV = "TRIVY_TPU_DUMP_MAX_AGE_S"
 
+# total-bytes cap on the dump dir (unset/0 = off): DUMP_CAP bounds
+# the file COUNT, but a soak with repeated designed SLO trips dumps
+# deep traces — N files of unbounded size is still an unbounded
+# dir. Oldest dumps go first; the newest dump always survives even
+# when it alone exceeds the cap (evidence of the trip that just
+# happened beats an empty dir)
+DUMP_MAX_BYTES_ENV = "TRIVY_TPU_DUMP_MAX_BYTES"
+
 
 class FlightRecorder:
     """Last-N completed traces + recent log events, thread-safe."""
@@ -170,6 +178,11 @@ class FlightRecorder:
                                            "0") or 0)
         except ValueError:
             max_age = 0.0
+        try:
+            max_bytes = int(float(os.environ.get(
+                DUMP_MAX_BYTES_ENV, "0") or 0))
+        except ValueError:
+            max_bytes = 0
         now = self._clock()
         with self._lock:
             self.dumps += 1
@@ -192,6 +205,15 @@ class FlightRecorder:
                 prune.append(self._dump_paths.popleft())
             for _, _, b in prune:
                 self.dump_bytes -= b
+            if max_bytes > 0:
+                # rotate by TOTAL bytes, oldest first — but never
+                # the dump just written: the freshest evidence is
+                # the one an operator is about to fetch
+                while self.dump_bytes > max_bytes and \
+                        len(self._dump_paths) > 1:
+                    victim = self._dump_paths.popleft()
+                    self.dump_bytes -= victim[2]
+                    prune.append(victim)
             self.dumps_pruned += len(prune)
         for old, _, _ in prune:
             try:

@@ -55,8 +55,13 @@ _BAD_OUTCOMES = ("failed", "timed_out")
 
 @dataclass(frozen=True)
 class SLO:
-    """One declarative objective. ``kind`` is ``availability`` or
-    ``latency``; latency SLOs additionally need ``threshold_s``."""
+    """One declarative objective. ``kind`` is ``availability``,
+    ``latency`` (additionally needs ``threshold_s``), or
+    ``efficiency`` — the MFU-style goodput gauge: its events are
+    device MILLISECONDS (useful vs. demand-gated idle, booked by
+    :meth:`SloEngine.record_device` from the cost-attribution
+    plane), not request outcomes, and ``objective`` is the target
+    useful share of device wall."""
 
     name: str
     kind: str = "availability"
@@ -66,7 +71,8 @@ class SLO:
     min_priority: int = -(10 ** 9)  # scope to a priority class
 
     def __post_init__(self):
-        if self.kind not in ("availability", "latency"):
+        if self.kind not in ("availability", "latency",
+                             "efficiency"):
             raise ValueError(f"SLO {self.name!r}: unknown kind "
                              f"{self.kind!r}")
         if not 0.0 < self.objective < 1.0:
@@ -84,6 +90,10 @@ class SLO:
     def classify(self, outcome: str, latency_s: float):
         """True=good, False=bad, None=out of scope (cancelled
         requests are the caller's choice, not the service's)."""
+        if self.kind == "efficiency":
+            # device-time events only (record_device) — a request
+            # outcome carries no goodput information
+            return None
         if outcome == "cancelled":
             return None
         if self.kind == "availability":
@@ -158,6 +168,27 @@ def parse_slo_config(text) -> list:
         # error path, not SloEngine.__init__ deep in server setup
         raise ValueError(f"duplicate SLO names: {names}")
     return out
+
+
+def _trip_thresholds(kind: str, fast_burn: float,
+                     slow_burn: float) -> tuple:
+    """Per-kind burn thresholds. An efficiency book's burn rate is
+    bounded by ``1 / (1 - objective)`` — idle share can never
+    exceed 1 — so the standard 14.4/6 multipliers would be
+    unreachable; an efficiency SLO trips at burn >= 1 on both
+    windows of a pair, i.e. measured useful share below the
+    objective sustained across the window pair."""
+    if kind == "efficiency":
+        return 1.0, 1.0
+    return fast_burn, slow_burn
+
+
+def _window_share(book, now: float, window_s: float) -> float:
+    """Good share over one trailing window (the efficiency gauge
+    value); 0 when the window is empty."""
+    good, bad = SloEngine._window_counts(book, now, window_s)
+    total = good + bad
+    return good / total if total else 0.0
 
 
 class _Exemplar:
@@ -248,6 +279,36 @@ class SloEngine:
         if due:
             self.verdicts(now=now)
 
+    def record_device(self, useful_s: float,
+                      idle_s: float = 0.0) -> None:
+        """Book device goodput into every ``kind=efficiency``
+        book: ``useful_s`` of attributed device wall as good
+        events, ``idle_s`` of demand-gated idle (the device sat
+        while admitted work waited) as bad — both in integer
+        milliseconds so the ring stays count-shaped and the burn/
+        federation math applies unchanged. Called by the scheduler
+        at every dispatch collection (obs/cost.py); a no-op when no
+        efficiency SLO is declared."""
+        good_ms = max(0, int(float(useful_s) * 1000.0))
+        bad_ms = max(0, int(float(idle_s) * 1000.0))
+        if not good_ms and not bad_ms:
+            return
+        now = time.monotonic()
+        bucket = int(now / _BUCKET_S)
+        with self._lock:
+            for book in self._books.values():
+                if book.slo.kind != "efficiency":
+                    continue
+                slot = book.ring.get(bucket)
+                if slot is None:
+                    slot = book.ring[bucket] = [0, 0]
+                    while len(book.ring) > _RING_CAP:
+                        book.ring.pop(next(iter(book.ring)))
+                slot[0] += good_ms
+                slot[1] += bad_ms
+                book.good += good_ms
+                book.bad += bad_ms
+
     # --- burn-rate math ---
 
     @staticmethod
@@ -291,10 +352,12 @@ class SloEngine:
                     "30m": self._burn(book, now, SLOW_WINDOWS[1]),
                     "6h": self._burn(book, now, SLOW_WINDOWS[2]),
                 }
-                fast = burns["5m"] >= self.fast_burn and \
-                    burns["1h"] >= self.fast_burn
-                slow = burns["30m"] >= self.slow_burn and \
-                    burns["6h"] >= self.slow_burn
+                fast_thr, slow_thr = _trip_thresholds(
+                    slo.kind, self.fast_burn, self.slow_burn)
+                fast = burns["5m"] >= fast_thr and \
+                    burns["1h"] >= fast_thr
+                slow = burns["30m"] >= slow_thr and \
+                    burns["6h"] >= slow_thr
                 if (fast and not book.fast_tripped) or \
                         (slow and not book.slow_tripped):
                     book.trips += 1
@@ -319,6 +382,11 @@ class SloEngine:
                 }
                 if slo.kind == "latency":
                     entry["threshold_s"] = slo.threshold_s
+                if slo.kind == "efficiency":
+                    # the MFU-style gauge: useful share of device
+                    # wall over the fast window (ms-weighted)
+                    entry["efficiency"] = round(_window_share(
+                        book, now, FAST_WINDOWS[1]), 4)
                 if slo.tenant:
                     entry["tenant"] = slo.tenant
                 out.append(entry)
@@ -463,9 +531,11 @@ def verdicts_from_export(export: dict, now=None,
             "30m": SloEngine._burn(book, now, SLOW_WINDOWS[1]),
             "6h": SloEngine._burn(book, now, SLOW_WINDOWS[2]),
         }
-        fast = burns["5m"] >= fast_burn and burns["1h"] >= fast_burn
-        slow = burns["30m"] >= slow_burn and \
-            burns["6h"] >= slow_burn
+        fast_thr, slow_thr = _trip_thresholds(
+            slo.kind, fast_burn, slow_burn)
+        fast = burns["5m"] >= fast_thr and burns["1h"] >= fast_thr
+        slow = burns["30m"] >= slow_thr and \
+            burns["6h"] >= slow_thr
         verdict = {
             "name": slo.name,
             "kind": slo.kind,
@@ -481,6 +551,9 @@ def verdicts_from_export(export: dict, now=None,
         }
         if slo.kind == "latency":
             verdict["threshold_s"] = slo.threshold_s
+        if slo.kind == "efficiency":
+            verdict["efficiency"] = round(_window_share(
+                book, now, FAST_WINDOWS[1]), 4)
         if slo.tenant:
             verdict["tenant"] = slo.tenant
         out.append(verdict)

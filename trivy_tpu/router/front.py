@@ -85,6 +85,18 @@ class RouterServer:
             token=self.router.token,
             token_header=self.router.token_header)
 
+    def costs(self) -> dict:
+        """``GET /costs`` — fleet per-tenant invoice: every
+        replica's cost-ledger export merged by (tenant) and
+        (age, tenant), with the fleet-wide accounting-identity
+        verdict (obs/cost.py:federated_costs). A down replica makes
+        the answer partial (``complete: false``), never an error."""
+        from ..obs.cost import federated_costs
+        return federated_costs(
+            [(h.name, h.url) for h in self.router.replicas()],
+            token=self.router.token,
+            token_header=self.router.token_header)
+
     def close(self) -> None:
         if self.scaler is not None:
             self.scaler.stop()
@@ -158,6 +170,13 @@ def _make_handler(front: RouterServer):
                         "msg": "missing cve= query parameter"})
                     return
                 self._reply(200, front.impact(cve[:256]))
+            elif self.path == "/costs":
+                # fleet cost rollup: partial answers carry
+                # complete=false, a fully dark fleet still answers
+                # 200 with empty books — never a 5xx
+                if not self._authorized():
+                    return
+                self._reply(200, front.costs())
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})

@@ -255,7 +255,10 @@ def federated_verdicts(router, token: str = "",
     """The default scaling-signal source: a PR-13 Federator over the
     router's CURRENT replica set, rebuilt only when membership
     changes, answering ``{"slo_ok": bool, "complete": bool}`` from
-    the merged burn-rate verdicts."""
+    the merged burn-rate verdicts — plus the fleet cost signal
+    (``cost_per_scan_s``: attributed device-seconds per completed
+    request, from the same snapshot pull) so scaling decisions see
+    efficiency next to latency."""
     from ..obs.federate import Federator
     state = {"key": None, "federator": None}
 
@@ -270,12 +273,49 @@ def federated_verdicts(router, token: str = "",
         fed = state["federator"]
         if fed is None:
             return {"slo_ok": True, "complete": False, "slos": []}
-        fleet = fed.fleet_slo({}, fed.collect())
+        rows = fed.collect()
+        fleet = fed.fleet_slo({}, rows)
         return {"slo_ok": bool(fleet.get("slo_ok", True)),
                 "complete": bool(fleet.get("complete", False)),
-                "slos": fleet.get("slos") or []}
+                "slos": fleet.get("slos") or [],
+                "cost": _fleet_cost(rows)}
 
     return verdict
+
+
+def _fleet_cost(rows) -> dict:
+    """Fleet cost-per-scan from the snapshot pull's ``cost_export``
+    sections — no second network round-trip. Replicas predating the
+    cost plane simply contribute nothing."""
+    from ..obs.cost import (balance, device_seconds,
+                            merge_cost_exports)
+    exports = []
+    measured_s = 0.0
+    for row in rows:
+        snap = row.get("snapshot")
+        ce = snap.get("cost_export") if snap else None
+        if not isinstance(ce, dict):
+            continue
+        if isinstance(ce.get("export"), dict):
+            exports.append(ce["export"])
+        try:
+            measured_s += float(ce.get("measured_device_s", 0.0))
+        except (TypeError, ValueError):
+            pass
+    merged = merge_cost_exports(exports)
+    attributed_s = 0.0
+    requests = 0.0
+    for vec in merged["cum"].values():
+        attributed_s += device_seconds(vec)
+        requests += float(vec.get("requests", 0.0))
+    return {
+        "attributed_device_s": round(attributed_s, 6),
+        "measured_device_s": round(measured_s, 6),
+        "requests": int(requests),
+        "cost_per_scan_s": round(attributed_s / requests, 6)
+        if requests > 0 else 0.0,
+        "balance": balance(attributed_s, measured_s),
+    }
 
 
 class Autoscaler:
@@ -408,6 +448,12 @@ class Autoscaler:
                  "slo_ok": bool(verdict.get("slo_ok", True)),
                  "complete": bool(verdict.get("complete", False)),
                  "draining": sorted(self._draining)}
+        cost = verdict.get("cost")
+        if isinstance(cost, dict):
+            # cost-per-scan rides next to the latency verdict: a
+            # scale decision's efficiency context in the event log
+            event["cost_per_scan_s"] = cost.get("cost_per_scan_s",
+                                                0.0)
         self.decisions.append(event)
         del self.decisions[:-256]
         return event

@@ -158,6 +158,14 @@ class SimReplica:
                         objective=slo_availability)] + \
                    [s for s in slos if s.kind != "availability"]
         self.slo = SloEngine(slos)
+        # per-sim cost books (obs/cost.py): each sim owns its OWN
+        # ledger (many sims share one process, the global singleton
+        # would mix their invoices); charged in scan() with exactly
+        # the simulated service wall, so the fleet books balance
+        # identically to a real replica's
+        from ..obs.cost import CostLedger
+        self.cost_ledger = CostLedger()
+        self._device_s = 0.0      # measured device-time integral
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -431,6 +439,19 @@ class SimReplica:
                     # like the real findings memo does
                     time.sleep(self.service_ms / 1000.0
                                * (0.1 if hit else 1.0))
+            # cost attribution: the simulated service wall IS the
+            # device time; booking the same value on both sides
+            # keeps the fleet accounting identity exact
+            work_s = (self.service_ms / 1000.0
+                      * (0.1 if hit else 1.0)) \
+                if self.service_ms else 0.0
+            with self._lock:
+                self._device_s += work_s
+            self.cost_ledger.charge(
+                tenant or "", device_interval_s=work_s,
+                memo_hits=1 if hit else 0,
+                memo_misses=0 if hit else 1,
+                requests=1)
             with self._lock:
                 self.counters["scans"] += 1
                 n = self.counters["scans"]
@@ -593,11 +614,31 @@ class SimReplica:
         (same shape as ``rpc/server.py metrics_snapshot``): name,
         build identity, prom text, the age-keyed SLO export, and the
         replica's monotonic now for staleness checks."""
+        with self._lock:
+            measured = self._device_s
         return {"name": self.name,
                 "build_info": self.build_info(),
                 "prom": self.metrics_text(),
                 "slo_export": self.slo.export_state(),
+                "cost_export": {
+                    "export": self.cost_ledger.export_state(),
+                    "measured_device_s": round(measured, 6)},
                 "mono": time.monotonic()}
+
+    def costs(self) -> dict:
+        """``GET /costs`` — same contract as the real server's
+        (rpc/server.py): invoice + identity verdict + federation
+        export."""
+        from ..obs.cost import balance
+        with self._lock:
+            measured = self._device_s
+        out = self.cost_ledger.snapshot()
+        out["measured_device_s"] = round(measured, 6)
+        out["balance"] = balance(out.get("device_s", 0.0), measured)
+        out["replica"] = self.name
+        out["export"] = self.cost_ledger.export_state()
+        out["complete"] = True
+        return out
 
 
 def _make_handler(sim: SimReplica):
@@ -627,6 +668,8 @@ def _make_handler(sim: SimReplica):
                 self._reply(200, sim.metrics_snapshot())
             elif self.path == "/handoff":
                 self._reply(200, sim.handoff())
+            elif self.path == "/costs":
+                self._reply(200, sim.costs())
             else:
                 self._reply(404, {"code": "bad_route",
                                   "msg": self.path})

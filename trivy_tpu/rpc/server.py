@@ -685,6 +685,11 @@ class ScanServer:
             out["impact"] = self.impact.stats()
         if "slo" not in out:
             out["slo"] = self.slo.snapshot()
+        if "cost" not in out:
+            # sched-off servers still report the cost books (memo
+            # attribution charges on the direct path too)
+            from ..obs.cost import COST_LEDGER
+            out["cost"] = COST_LEDGER.snapshot()
         # elastic-lifecycle counters (prewarm/handoff) and the AOT
         # compile-cache split — identical section shape on both
         # sched modes (docs/serving.md "Elastic lifecycle")
@@ -749,14 +754,42 @@ class ScanServer:
 
     def metrics_snapshot(self) -> dict:
         """The ``GET /metrics/snapshot`` payload a federating front
-        pulls: replica identity, the full prom exposition, and the
-        SLO engine's age-keyed bucket export (monotonic-only, so the
-        front can rebase it onto its own clock)."""
+        pulls: replica identity, the full prom exposition, the SLO
+        engine's age-keyed bucket export (monotonic-only, so the
+        front can rebase it onto its own clock), and the cost
+        ledger's export in the same coordinate — the autoscaler
+        reads fleet cost-per-scan from it without a second pull."""
+        from ..obs.cost import COST_LEDGER
+        measured = self.scheduler.metrics.device_time_s() \
+            if self.scheduler is not None else 0.0
         return {"name": self.replica_name,
                 "build_info": self.build_info(),
                 "prom": self.metrics_text(),
                 "slo_export": self.slo.export_state(),
+                "cost_export": {
+                    "export": COST_LEDGER.export_state(),
+                    "measured_device_s": round(measured, 6)},
                 "mono": time.monotonic()}
+
+    def costs(self) -> dict:
+        """The ``GET /costs`` payload: this replica's per-tenant
+        invoice, the accounting-identity verdict, and the age-keyed
+        export a federating front merges (obs/cost.py,
+        docs/observability.md "Cost attribution & goodput")."""
+        from ..obs.cost import COST_LEDGER, balance
+        if self.scheduler is not None:
+            out = self.scheduler.cost_snapshot()
+        else:
+            from ..runtime.aot import COMPILE_CACHE_METRICS
+            aot = COMPILE_CACHE_METRICS.snapshot()
+            out = COST_LEDGER.snapshot(
+                aot_compile_s=float(aot.get("seconds", 0.0) or 0.0))
+            out["measured_device_s"] = 0.0
+            out["balance"] = balance(out.get("device_s", 0.0), 0.0)
+        out["replica"] = self.replica_name
+        out["export"] = COST_LEDGER.export_state()
+        out["complete"] = True
+        return out
 
     def federate_text(self) -> str:
         """The ``GET /metrics/federate`` exposition: this replica's
@@ -950,6 +983,13 @@ def _make_handler(server: ScanServer):
                 if not self._authorized():
                     return
                 self._reply(200, server.slo_verdicts())
+            elif self.path == "/costs":
+                # per-tenant cost ledger + goodput reconciliation
+                # (docs/observability.md "Cost attribution &
+                # goodput"): operational detail, token-gated
+                if not self._authorized():
+                    return
+                self._reply(200, server.costs())
             elif self.path == "/handoff":
                 # drain handoff (docs/serving.md "Elastic
                 # lifecycle"): the hot-digest working set a ring

@@ -137,6 +137,19 @@ class LocalScanner:
             # for the device dispatch (docs/performance.md)
             prepared.memo_plan = self.memo.partition(
                 prepared, blobs, detail, options, db=self.store)
+            plan = prepared.memo_plan
+            if plan is not None:
+                # cost attribution (obs/cost.py): memo hits are
+                # device work this tenant did NOT pay for — the
+                # invoice shows them next to the device-seconds
+                # the misses went on to cost
+                from ..obs.cost import COST_LEDGER
+                COST_LEDGER.charge(
+                    self.tenant,
+                    memo_hits=int(getattr(plan, "queries_hit", 0)
+                                  or 0),
+                    memo_misses=int(getattr(plan, "queries_miss",
+                                            0) or 0))
         return prepared
 
     def finish(self, prepared: PreparedScan,

@@ -75,6 +75,10 @@ class SchedConfig:
     # declarations the scheduler's burn-rate engine evaluates
     # (--slo-config). None = the default availability/latency pair
     slos: object = None
+    # per-tenant device-second budgets (obs/cost.py): the
+    # --tenant-budget grammar or a {tenant: TenantBudget} dict.
+    # None = no budget admission
+    budgets: object = None
 
 
 @dataclass

@@ -142,6 +142,13 @@ class SchedMetrics:
         self._device_since = None
         self._host_busy_s = 0.0
         self._device_busy_s = 0.0
+        # per-dispatch device-time INTEGRAL (sum of every dispatch
+        # window's wall, overlaps double-counted): the measured side
+        # of the cost-attribution balance identity — the ledger
+        # attributes each dispatch's wall across its requests, so
+        # attributed totals must equal this integral, not the union
+        # busy wall (obs/cost.py)
+        self._device_time_s = 0.0
         self._overlap_s = 0.0
         self._both_since = None
         self._depth_fn = None         # live queue-depth gauge
@@ -223,10 +230,11 @@ class SchedMetrics:
             self._update_both(now)
         return now
 
-    def device_end(self, t0: float) -> None:
+    def device_end(self, t0: float) -> float:
         now = time.monotonic()
         with self._lock:
             self._device_active -= 1
+            self._device_time_s += now - t0
             if self._device_active == 0 and \
                     self._device_since is not None:
                 # union accounting: busy wall accrues only when the
@@ -234,6 +242,14 @@ class SchedMetrics:
                 self._device_busy_s += now - self._device_since
                 self._device_since = None
             self._update_both(now)
+        # this dispatch's own wall — the executor attributes it
+        # across the batch's requests (obs/cost.py)
+        return now - t0
+
+    def device_time_s(self) -> float:
+        """The per-dispatch device-time integral so far."""
+        with self._lock:
+            return self._device_time_s
 
     # --- snapshot ---
 
@@ -283,6 +299,7 @@ class SchedMetrics:
                 },
                 "host_busy_s": round(self._host_busy_s, 4),
                 "device_busy_s": round(self._device_busy_s, 4),
+                "device_time_s": round(self._device_time_s, 6),
                 "overlap_s": round(overlap, 4),
                 "overlap_ratio": round(
                     overlap / self._device_busy_s, 4)

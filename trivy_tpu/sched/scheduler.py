@@ -51,6 +51,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from ..obs.cost import COST_LEDGER, parse_budget_config
 from ..obs.trace import get_tracer, trace_cause
 from ..utils import get_logger
 from .coalescer import Batch, Coalescer, SchedConfig
@@ -119,6 +120,13 @@ class ScanScheduler:
         self.queue = TenantQueue(self.config.max_queue,
                                  tenancy=getattr(self.config,
                                                  "tenancy", None))
+        # per-tenant device-second budgets (--tenant-budget,
+        # obs/cost.py): admission consults the windowed cost ledger
+        # and throttles (429) or deprioritizes over-budget tenants
+        budgets = getattr(self.config, "budgets", None)
+        if budgets:
+            self.queue.configure_budgets(
+                parse_budget_config(budgets), COST_LEDGER)
         self.metrics.set_depth_gauge(self.queue.depth)
         self.coalescer = Coalescer(self.config)
         # dispatch ring (runtime/ring.py): bounds launched-but-
@@ -132,6 +140,10 @@ class ScanScheduler:
         self._cv = threading.Condition()
         self._analyzing = 0
         self._kernel_s = 0.0      # interval-kernel wall (all batches)
+        # monotonic end of the last metered device dispatch — the
+        # demand-gated idle baseline (goodput: device time between
+        # "work was ready" and "dispatch started" is waste)
+        self._last_device_end = None
         self._running = False
         self._draining = False
         self._batch_seq = 0       # device-thread only (batch ids)
@@ -300,6 +312,27 @@ class ScanScheduler:
         out["slo"] = self.slo.snapshot()
         with self._lock:
             out["interval_kernel_s"] = round(self._kernel_s, 4)
+        # per-tenant cost books + the goodput reconciliation
+        # (docs/observability.md "Cost attribution & goodput")
+        out["cost"] = self.cost_snapshot()
+        return out
+
+    def cost_snapshot(self) -> dict:
+        """The cost plane's replica-local view: per-tenant ledger
+        (AOT compile wall amortized by device-second share), the
+        measured per-dispatch device-time integral, and the
+        accounting-identity verdict — served at ``GET /costs`` and
+        inside ``stats()["cost"]``."""
+        from ..obs.cost import balance
+        from ..runtime.aot import COMPILE_CACHE_METRICS
+        aot = COMPILE_CACHE_METRICS.snapshot()
+        ledger = COST_LEDGER.snapshot(
+            aot_compile_s=float(aot.get("seconds", 0.0) or 0.0))
+        measured = self.metrics.device_time_s()
+        out = dict(ledger)
+        out["measured_device_s"] = round(measured, 6)
+        out["balance"] = balance(ledger.get("device_s", 0.0),
+                                 measured)
         return out
 
     # --- cross-request blob dependencies (called from analyze) ---
@@ -370,6 +403,8 @@ class ScanScheduler:
             self.metrics.inc("completed")
             self.metrics.observe("request", latency,
                                  trace_id=req.trace_id or "")
+            COST_LEDGER.charge(getattr(req, "tenant", "") or "",
+                               requests=1)
             status = "degraded" if req.faults else "ok"
             self.queue.note_done(req, status, latency)
             self._end_trace(req, status)
@@ -460,7 +495,15 @@ class ScanScheduler:
             self._fail(req, e)
         finally:
             self.metrics.host_end(t0)
-            self.metrics.observe("analyze", time.monotonic() - t0)
+            host_s = time.monotonic() - t0
+            self.metrics.observe("analyze", host_s,
+                                 trace_id=req.trace_id or "")
+            work = getattr(req, "work", None)
+            COST_LEDGER.charge(
+                getattr(req, "tenant", "") or "",
+                host_analyze_s=host_s,
+                bytes_in=float(getattr(work, "candidate_bytes", 0)
+                               or 0))
             with self._cv:
                 self._analyzing -= 1
                 self._cv.notify_all()
@@ -637,6 +680,66 @@ class ScanScheduler:
             self._unwind_slot(slot, error=e)
             raise
 
+    def _meter_dispatch(self, reqs: list, t0, wall_s: float,
+                        kstats: dict, sieved: bool) -> None:
+        """Book one device dispatch's wall into the cost plane:
+
+        * goodput — the dispatch wall is useful device time; the gap
+          between this dispatch's start and max(previous dispatch
+          end, earliest submit in the batch) is DEMAND-GATED idle
+          (the device sat while admitted work waited) — both feed
+          every ``kind=efficiency`` SLO book;
+        * attribution — the wall splits by kernel family (the
+          interval bucket-ladder's measured ``device_s`` vs the DFA
+          sieve remainder) and lands on each request's tenant
+          proportionally to its work volume (candidate bytes +
+          interval jobs), so per-tenant books sum back to the
+          measured dispatch integral by construction.
+
+        Called on every path that closed a device_begin — success,
+        unwind, and the sync bisect ladder — so failed dispatches
+        are billed too and the identity holds through quarantine."""
+        if t0 is None or not reqs:
+            return
+        wall_s = max(0.0, wall_s)
+        gate = min((r.submitted_at for r in reqs), default=t0)
+        with self._lock:
+            if self._last_device_end is not None:
+                gate = max(gate, self._last_device_end)
+                idle_s = max(0.0, t0 - gate)
+            else:
+                # first dispatch of the process: warm-up, not waste
+                idle_s = 0.0
+            end = t0 + wall_s
+            if self._last_device_end is None \
+                    or end > self._last_device_end:
+                self._last_device_end = end
+        self.slo.record_device(wall_s, idle_s=idle_s)
+        if not COST_LEDGER.enabled:
+            return
+        interval_s = min(wall_s, max(0.0, float(
+            (kstats or {}).get("device_s", 0.0) or 0.0)))
+        if sieved:
+            dfa_s = wall_s - interval_s
+        else:
+            # no sieve in this dispatch: the whole wall is the
+            # interval ladder (enqueue + materialize included)
+            interval_s, dfa_s = wall_s, 0.0
+        weights = []
+        for r in reqs:
+            w = getattr(r, "work", None)
+            weights.append(
+                float(getattr(w, "candidate_bytes", 0) or 0)
+                + float(len(getattr(w, "jobs", ()) or ())))
+        total_w = sum(weights)
+        n = len(reqs)
+        for r, w in zip(reqs, weights):
+            share = (w / total_w) if total_w > 0 else (1.0 / n)
+            COST_LEDGER.charge(
+                getattr(r, "tenant", "") or "",
+                device_interval_s=interval_s * share,
+                device_dfa_s=dfa_s * share)
+
     def _unwind_slot(self, slot: dict, error=None) -> None:
         """Restore payload tags + close accounting for a slot that
         will not produce results itself (launch/collect failure —
@@ -644,7 +747,10 @@ class ScanScheduler:
         for job, orig in slot["wrapped"]:
             job.payload = orig
         if slot["t0"] is not None:
-            self.metrics.device_end(slot["t0"])
+            wall = self.metrics.device_end(slot["t0"])
+            self._meter_dispatch(slot["reqs"], slot["t0"], wall,
+                                 slot["kstats"],
+                                 slot["sieve"] is not None)
         for sp in slot["spans"]:
             if error is not None:
                 sp.event("device_failed", error=repr(error))
@@ -686,9 +792,13 @@ class ScanScheduler:
             return
         for job, orig in slot["wrapped"]:
             job.payload = orig
-        self.metrics.device_end(slot["t0"])
+        wall = self.metrics.device_end(slot["t0"])
+        self._meter_dispatch(reqs, slot["t0"], wall,
+                             slot["kstats"],
+                             slot["sieve"] is not None)
         self.metrics.observe("device",
-                             time.monotonic() - slot["t0"])
+                             time.monotonic() - slot["t0"],
+                             trace_id=reqs[0].trace_id or "")
         for sp in spans:
             sp.end()
         results = {id(r): (found_by.get(i, []),
@@ -794,10 +904,11 @@ class ScanScheduler:
                     wrapped.append((job, job.payload))
                     job.payload = (i, job.payload)
 
+            kstats: dict = {}        # per-batch, not global
+            sieve_handle = None
             t0 = self.metrics.device_begin()
             try:
                 with batch_ctx:
-                    sieve_handle = None
                     if files and self.secret_scanner is not None:
                         # async enqueue: the device sieves while the
                         # interval dispatch below compiles/queues
@@ -808,7 +919,6 @@ class ScanScheduler:
                     all_jobs = [job for job, _ in wrapped]
                     detected_by: dict = {}
                     if all_jobs:
-                        kstats: dict = {}  # per-batch, not global
                         for i, payload in dispatch_jobs(
                                 all_jobs, backend=group,
                                 mesh=self.mesh, stats=kstats):
@@ -829,8 +939,15 @@ class ScanScheduler:
             finally:
                 for job, orig in wrapped:
                     job.payload = orig
-                self.metrics.device_end(t0)
-            self.metrics.observe("device", time.monotonic() - t0)
+                wall = self.metrics.device_end(t0)
+                # billed even when the dispatch raised: the device
+                # wall was spent either way, and the bisect ladder's
+                # halves re-bill their own walls — the accounting
+                # identity survives poison isolation
+                self._meter_dispatch(reqs, t0, wall, kstats,
+                                     sieve_handle is not None)
+            self.metrics.observe("device", time.monotonic() - t0,
+                                 trace_id=reqs[0].trace_id or "")
         except Exception as e:       # noqa: BLE001
             for sp in spans:
                 sp.event("device_failed", error=repr(e))
@@ -986,4 +1103,8 @@ class ScanScheduler:
             self._fail(req, e)
         finally:
             self.metrics.host_end(t0)
-            self.metrics.observe("finish", time.monotonic() - t0)
+            host_s = time.monotonic() - t0
+            self.metrics.observe("finish", host_s,
+                                 trace_id=req.trace_id or "")
+            COST_LEDGER.charge(getattr(req, "tenant", "") or "",
+                               host_finish_s=host_s)

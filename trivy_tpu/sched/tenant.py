@@ -229,7 +229,8 @@ class TenantBook:
     pipeline drains (rejections never count as admitted)."""
 
     OUTCOMES = ("ok", "degraded", "failed", "timed_out", "cancelled")
-    REJECTIONS = ("rejected_rate", "rejected_quota", "rejected_503")
+    REJECTIONS = ("rejected_rate", "rejected_quota",
+                  "rejected_budget", "rejected_503")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -283,7 +284,8 @@ class TenantBook:
                 h = self._hist.get(t)
                 entry = {
                     "counters": c,
-                    "shed": c["rejected_rate"] + c["rejected_quota"],
+                    "shed": c["rejected_rate"] + c["rejected_quota"]
+                    + c["rejected_budget"],
                     "latency": h.to_dict() if h is not None
                     else LatencyHistogram().to_dict(),
                 }
@@ -329,6 +331,19 @@ class TenantQueue:
         self._vtime = 0.0              # pass of the last pop
         self._seq = 0
         self._closed = False
+        # device-second budgets (--tenant-budget, obs/cost.py):
+        # tenant -> TenantBudget, read against the cost ledger's
+        # windowed books at admission
+        self._budgets: dict = {}
+        self._cost_ledger = None
+
+    def configure_budgets(self, budgets: dict, ledger) -> None:
+        """Arm budget admission: ``budgets`` maps tenant →
+        :class:`~trivy_tpu.obs.cost.TenantBudget`; ``ledger`` is the
+        :class:`~trivy_tpu.obs.cost.CostLedger` whose windowed
+        device-second books the check reads."""
+        self._budgets = dict(budgets or {})
+        self._cost_ledger = ledger
 
     # --- tenant resolution (under lock) ---
 
@@ -361,6 +376,40 @@ class TenantQueue:
         tenant = ""
         event = ""
         try:
+            # budget gate BEFORE the cv: the windowed-spend read
+            # takes the cost ledger's own lock, and lock discipline
+            # forbids acquiring another module's lock under ours.
+            # The read is microseconds stale by admission time —
+            # budgets are a 10s-bucketed signal, staleness within
+            # one lock handoff is noise
+            budget = self._budgets.get(
+                getattr(req, "tenant", "")
+                or self.tenancy.anonymous) \
+                if self._budgets else None
+            if budget is not None and self._cost_ledger is not None:
+                spend = self._cost_ledger.window_device_s(
+                    budget.tenant, budget.window_s)
+                if spend >= budget.device_s:
+                    if budget.action == "throttle":
+                        tenant = budget.tenant
+                        event = "rejected_budget"
+                        e = RateLimitedError(
+                            f"tenant {budget.tenant!r} over "
+                            f"device-second budget "
+                            f"({spend:.3f}s of {budget.device_s:g}s"
+                            f" per {budget.window_s:g}s)",
+                            retry_after_s=max(
+                                1.0, min(budget.window_s / 4,
+                                         10.0)),
+                            tenant=budget.tenant)
+                        e.book_event = "rejected_budget"
+                        raise e
+                    # deprioritize: admit, but at the budget's
+                    # priority floor — the request yields inside
+                    # its own tenant lane until the spend ages out
+                    if int(getattr(req, "priority", 0) or 0) \
+                            > budget.floor:
+                        req.priority = budget.floor
             with self._cv:
                 if self._closed:
                     raise SchedulerClosed("scheduler is closed")

@@ -345,6 +345,12 @@ class SoakRunner:
             gate=False)
         self.audit.add_probe("replica_rss_bytes",
                              self._probe_replica_rss)
+        # high-water RSS rides the same sampler; the ratchet is
+        # monotone by design (procstats.peak ratchet), so it informs
+        # the report but never gates the bounded-growth verdict
+        self.audit.add_probe("replica_peak_rss_bytes",
+                             self._probe_replica_peak_rss,
+                             gate=False)
 
     def _replica_metrics(self) -> list:
         import urllib.request
@@ -371,6 +377,13 @@ class SoakRunner:
         rows = self._replica_metrics()
         vals = [int((r.get("process") or {}).get("rss_bytes", -1))
                 for r in rows]
+        vals = [v for v in vals if v > 0]
+        return max(vals) if vals else -1
+
+    def _probe_replica_peak_rss(self):
+        rows = self._replica_metrics()
+        vals = [int((r.get("process") or {}).get(
+            "peak_rss_bytes", -1)) for r in rows]
         vals = [v for v in vals if v > 0]
         return max(vals) if vals else -1
 
@@ -415,6 +428,26 @@ class SoakRunner:
                 and bool(fleet.get("slo_ok", True)),
                 "complete": bool(fleet.get("complete", False)),
                 "replicas": fleet.get("replicas", 0)}
+
+    def _fleet_invoice(self) -> dict:
+        """The per-tenant invoice at quiesce: the same federated
+        ``GET /costs`` rollup the router front serves
+        (obs/cost.py:federated_costs), plus the totals-match
+        identity the report gates on — the invoice's per-tenant
+        device-seconds must sum to the fleet ledger's attributed
+        total."""
+        from ..obs.cost import federated_costs
+        inv = federated_costs(
+            [(h.name, h.url) for h in self.router.replicas()],
+            token=self.token)
+        tenant_sum = sum(float(v.get("device_s", 0.0))
+                         for v in (inv.get("tenants") or
+                                   {}).values())
+        fleet_total = float(inv.get("attributed_device_s", 0.0))
+        inv["tenant_device_s"] = round(tenant_sum, 6)
+        inv["totals_match"] = abs(tenant_sum - fleet_total) \
+            <= max(1e-6, 1e-4 * max(fleet_total, 1.0))
+        return inv
 
     # ---- step execution ----
 
@@ -781,6 +814,9 @@ class SoakRunner:
         books_ok = watch_ok and lost == 0
         trip = self._trip_analysis()
         audit_v = self.audit.verdict()
+        invoice = self._fleet_invoice()
+        peak_rss = [v for v in self.audit.series(
+            "replica_peak_rss_bytes") if v > 0]
         replica_rows = sorted(self._replica_metrics(),
                               key=lambda r: r.get("name", ""))
         merged = MergedTimeline(
@@ -802,6 +838,7 @@ class SoakRunner:
             "lost": lost,
             "trips_exact": trip["trips_exact"],
             "audit_ok": audit_v["ok"],
+            "invoice_totals_match": invoice["totals_match"],
         }
         from ..router.lifecycle import LIFECYCLE_METRICS
         return {
@@ -823,12 +860,15 @@ class SoakRunner:
                     "trip": trip,
                     "local": self.engine.snapshot()},
             "audit": audit_v,
+            "costs": invoice,
             "throughput": {"sustained": self._sustained_ips(),
                            "scans_ok": counters["scans_ok"]},
             "fleet": {"mode": self.mode,
                       "replicas_start": self.n_replicas,
                       "replicas_end": len(replica_rows),
                       "replicas": replica_rows,
+                      "peak_rss_bytes": int(max(peak_rss))
+                      if peak_rss else -1,
                       # handoff counters booked by THIS process's
                       # run_handoff; per-replica prewarm counters
                       # ride the replica rows above
