@@ -751,12 +751,17 @@ class BareExceptRule(Rule):
     summary = ("No bare `except:` anywhere; no silent "
                "`except Exception: pass` at concurrency/IO seams.")
 
+    # trivy_tpu/artifact/ covers the streaming-ingest modules
+    # (stream.py, localreg.py, registry.py); trivy_tpu/scan/ joined
+    # when the prepare seam became part of the streaming pipeline
+    # (docs/performance.md §9)
     SEAMS = ("trivy_tpu/rpc/", "trivy_tpu/watch/",
              "trivy_tpu/sched/", "trivy_tpu/runtime/",
              "trivy_tpu/artifact/", "trivy_tpu/memo/",
              "trivy_tpu/obs/", "trivy_tpu/guard/",
              "trivy_tpu/faults/", "trivy_tpu/parallel/",
-             "trivy_tpu/router/", "trivy_tpu/impact/")
+             "trivy_tpu/router/", "trivy_tpu/impact/",
+             "trivy_tpu/scan/")
 
     @staticmethod
     def _is_silent(handler: ast.ExceptHandler) -> bool:

@@ -106,7 +106,12 @@ class ImageArtifact:
             disabled=_effective_disabled(self.opt),
             file_patterns=self.opt.file_patterns)
 
-    def inspect(self) -> ArtifactReference:
+    def cache_keys(self) -> tuple:
+        """``(artifact_id, blob_ids, base)`` — the content-addressed
+        cache keys :meth:`inspect` scans under. Needs only the image
+        *metadata* (id, config, diff_ids), never a layer byte, so the
+        streaming warm-layer probe can ask "which layers are already
+        cached?" before any blob GET is issued."""
         img = self.image
         import os as _os
         opts_key = {"skip_dirs": self.opt.skip_dirs,
@@ -156,6 +161,11 @@ class ImageArtifact:
                      if d in base else opts_key)
             for d in img.diff_ids]
         artifact_id = calc_key(img.id, versions, options=opts_key)
+        return artifact_id, blob_ids, base
+
+    def inspect(self) -> ArtifactReference:
+        img = self.image
+        artifact_id, blob_ids, base = self.cache_keys()
 
         try:
             missing_artifact, missing = self.cache.missing_blobs(
@@ -170,6 +180,15 @@ class ImageArtifact:
             add_event("inspect", layers=len(blob_ids),
                       missing=len(todo))
             if todo:
+                # streaming sources pipeline fetch+inflate in the
+                # background: (re)start exactly the missing layers
+                # and bind this thread's analyze span so the
+                # in-flight fetch/decompress stage spans land in the
+                # request's trace (idempotent; absent on
+                # materialized sources)
+                prefetch = getattr(img, "prefetch", None)
+                if prefetch is not None:
+                    prefetch(todo)
                 self._inspect_layers(todo, blob_ids, base)
             if missing_artifact and \
                     getattr(self, "_os_found", None) is None:
@@ -247,17 +266,25 @@ class ImageArtifact:
 
     def _analyze_layers(self, todo: list, layer_results: list,
                         all_candidates: list, base: set) -> None:
-        from ..obs.trace import add_event
+        from ..obs.trace import add_event, phase_span
         for i in todo:
             layer = self.image.layers[i]
             result = AnalysisResult()
+            # layer.open() blocks until the layer's bytes are ready;
+            # on a streaming source that wait is covered by the
+            # layer's own fetch/decompress spans (excluded by the
+            # timeline when they overlap device compute — pipelined
+            # staging), so the layer_analyze stage span deliberately
+            # starts AFTER the open and covers only walk + analyzers
             with layer.open() as tf:
-                files, opq_dirs, wh_files = collect_layer_tar(
-                    tf, budget=self.budget)
-                for path, size, read in files:
-                    if self._skipped(path):
-                        continue
-                    self.group.analyze_file(result, path, read, size)
+                with phase_span("layer_analyze", layer=i):
+                    files, opq_dirs, wh_files = collect_layer_tar(
+                        tf, budget=self.budget)
+                    for path, size, read in files:
+                        if self._skipped(path):
+                            continue
+                        self.group.analyze_file(result, path, read,
+                                                size)
             add_event("layer_analyzed", layer=i,
                       files=len(files))
             layer_results.append((i, result, opq_dirs, wh_files))

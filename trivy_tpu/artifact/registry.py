@@ -53,6 +53,13 @@ class RegistryError(ValueError):
     pass
 
 
+def _ingest_metrics():
+    # lazy: stream.py imports this module at its top, so the reverse
+    # edge must resolve at call time, not import time
+    from .stream import INGEST_METRICS
+    return INGEST_METRICS
+
+
 def parse_ref(ref: str) -> tuple:
     """'host[:port]/repo[:tag][@digest]' → (registry, repository,
     reference). Docker-Hub-style shorthand gets the reference
@@ -128,6 +135,10 @@ class DistributionClient:
         self.backoff_s = backoff_s
         self.backoff_max_s = backoff_max_s
         self._bearer: dict = {}             # registry → token
+        # optional faults/inject.FaultInjector: consulted once per
+        # blob chunk by the streaming fetch engine so the flaky-
+        # registry scenario can drop streams mid-body
+        self.fault_injector = None
 
     # ---- transport ----
 
@@ -255,45 +266,86 @@ class DistributionClient:
             return ""
         return doc.get("token") or doc.get("access_token") or ""
 
-    def _stream_blob(self, registry: str, repo: str, digest: str,
-                     blob_dir: str, chunk: int = 1 << 20) -> None:
-        """GET a blob streaming straight into the layout's blob
-        store, verifying the digest incrementally. Transient
-        failures (429/5xx/connection drops mid-stream) retry the
-        whole GET with backoff — the file is rewritten from offset
-        zero each attempt, so a torn stream can never leave a
-        partial blob behind."""
+    def fetch_blob(self, registry: str, repo: str, digest: str,
+                   write, restart, chunk: int = 1 << 20) -> int:
+        """Resumable streaming blob GET — the engine under both the
+        materialize path (:meth:`_stream_blob`) and the streaming
+        ingest pipeline (``artifact/stream.py``).
+
+        Each chunk is pushed to ``write(bytes)`` as it arrives; the
+        sha256 over the compressed stream is kept incrementally and
+        checked against the digest at EOF. On a retryable mid-body
+        drop the retry sends ``Range: bytes={offset}-``: a 206
+        answer resumes the stream with the hash state intact, while
+        a 200 (or a Content-Range that doesn't match) means the
+        registry rejected/ignored the range — ``restart()`` is
+        called so the sink rewinds and the fetch rewrites from
+        offset zero. An exception raised by ``write`` (a guard
+        budget trip, typically) is NOT caught here: it propagates
+        immediately, closing the response — the remaining body is
+        cancelled, not drained. Returns the blob's byte size."""
         from ..guard.safetar import validate_digest
         # the digest comes from a (possibly malicious) registry's
-        # manifest and names the output FILE — validate before it
-        # touches the filesystem or the URL
+        # manifest — validate before it touches the URL (or, in the
+        # _stream_blob wrapper, the filesystem)
         validate_digest(digest)
         url = self._base(registry) + f"/v2/{repo}/blobs/{digest}"
-        headers = self._auth_headers(registry,
-                                     "application/octet-stream")
+        base_headers = self._auth_headers(
+            registry, "application/octet-stream")
         ctx = None
         if url.startswith("https:") and self.insecure:
             ctx = ssl._create_unverified_context()
         want_hex = digest.partition(":")[2]
-        out_path = os.path.join(blob_dir, want_hex)
+        injector = self.fault_injector
+        h = hashlib.sha256()
+        offset = 0
         for attempt in range(self.retries + 1):
+            headers = dict(base_headers)
+            resuming = offset > 0
+            if resuming:
+                headers["Range"] = f"bytes={offset}-"
             try:
                 req = urllib.request.Request(url, headers=headers)
                 with urllib.request.urlopen(req, timeout=30,
-                                            context=ctx) as resp, \
-                        open(out_path, "wb") as out:
-                    h = hashlib.sha256()
+                                            context=ctx) as resp:
+                    crange = resp.headers.get("Content-Range", "")
+                    if resuming and (
+                            resp.status != 206 or not
+                            crange.startswith(f"bytes {offset}-")):
+                        # range rejected/ignored → offset-0 rewrite
+                        restart()
+                        h = hashlib.sha256()
+                        offset = 0
+                        resuming = False
+                        _ingest_metrics().inc("full_restarts")
+                    elif resuming:
+                        _ingest_metrics().inc("range_resumes")
                     while True:
                         data = resp.read(chunk)
                         if not data:
                             break
+                        if injector is not None:
+                            # a raised fault is the chunk being lost
+                            # in transit: nothing below runs
+                            injector.on_blob_chunk(digest, offset)
                         h.update(data)
-                        out.write(data)
+                        write(data)
+                        offset += len(data)
                 if h.hexdigest() != want_hex:
                     raise RegistryError(
                         f"blob {digest} digest mismatch")
-                return
+                return offset
             except urllib.error.HTTPError as e:
+                if e.code == 416 and resuming and \
+                        attempt < self.retries:
+                    # Range Not Satisfiable: forget the offset and
+                    # rewrite — costs one attempt like any retry
+                    restart()
+                    h = hashlib.sha256()
+                    offset = 0
+                    _ingest_metrics().inc("full_restarts")
+                    self._backoff(attempt, dict(e.headers))
+                    continue
                 if e.code in RETRYABLE_STATUSES and \
                         attempt < self.retries:
                     self._backoff(attempt, dict(e.headers))
@@ -303,11 +355,31 @@ class DistributionClient:
             except (urllib.error.URLError, OSError,
                     http.client.HTTPException) as e:
                 # IncompleteRead (a dropped stream mid-body) lands
-                # here — retried like any other connection failure
+                # here — retried like any other connection failure,
+                # resuming from the current offset
                 if attempt < self.retries:
                     self._backoff(attempt, None)
                     continue
                 raise RegistryError(f"registry unreachable: {e!r}")
+        raise RegistryError(f"retries exhausted for blob {digest}")
+
+    def _stream_blob(self, registry: str, repo: str, digest: str,
+                     blob_dir: str, chunk: int = 1 << 20) -> None:
+        """GET a blob streaming straight into the layout's blob
+        store, verifying the digest incrementally (a thin file sink
+        over :meth:`fetch_blob`, which handles Range resume on torn
+        streams — a drop mid-body costs one round trip, not the
+        bytes already on disk)."""
+        from ..guard.safetar import validate_digest
+        validate_digest(digest)
+        out_path = os.path.join(blob_dir, digest.partition(":")[2])
+        with open(out_path, "wb") as out:
+            def restart():
+                out.seek(0)
+                out.truncate()
+
+            self.fetch_blob(registry, repo, digest, out.write,
+                            restart, chunk=chunk)
 
     # ---- pull ----
 
@@ -340,7 +412,11 @@ class DistributionClient:
                 f"manifest digest mismatch: want {reference}, "
                 f"got sha256:{got}")
 
-    def pull(self, ref: str, budget=None) -> ImageSource:
+    def resolve_manifest(self, ref: str) -> tuple:
+        """``ref`` → ``(registry, repo, reference, manifest,
+        served_digest, ctype, body)``: the manifest GET, digest pin
+        and platform selection that :meth:`pull` and the streaming
+        ingest path (``artifact/stream.py``) share."""
         registry, repo, reference = parse_ref(ref)
         hdrs, body = self._get(
             registry, f"/v2/{repo}/manifests/{reference}")
@@ -359,9 +435,15 @@ class DistributionClient:
                 registry, f"/v2/{repo}/manifests/{digest}")
             self._verify_manifest(body, digest)
             manifest = json.loads(body)
-            # the layout's index entry must describe the resolved
-            # image manifest, not the list we started from
+            # the resolved image manifest, not the list we started
+            # from, is what callers must describe/load
             ctype = (hdrs.get("Content-Type") or "").split(";")[0]
+        return (registry, repo, reference, manifest, served_digest,
+                ctype, body)
+
+    def pull(self, ref: str, budget=None) -> ImageSource:
+        (registry, repo, reference, manifest, served_digest, ctype,
+         body) = self.resolve_manifest(ref)
 
         layout = tempfile.mkdtemp(prefix="trivy-tpu-pull-")
         blob_dir = os.path.join(layout, "blobs", "sha256")

@@ -57,6 +57,12 @@ class CacheFault(InjectedFault, ConnectionError):
     Redis/S3 failure — the circuit breaker keys off that)."""
 
 
+class RegistryStreamFault(InjectedFault, OSError):
+    """Injected mid-body registry stream drop (an OSError, so the
+    blob fetch engine's connection-failure retry path — the one
+    Range resume rides — handles it like a real torn stream)."""
+
+
 class FaultInjector:
     """Deterministic, thread-safe fault decisions for one scenario."""
 
@@ -73,7 +79,8 @@ class FaultInjector:
                          "rpc_errors": 0, "rpc_drops": 0,
                          "memo_loads": 0, "memo_corruptions": 0,
                          "routed_forwards": 0, "route_drops": 0,
-                         "replica_kills": 0}
+                         "replica_kills": 0, "blob_chunks": 0,
+                         "blob_stream_faults": 0}
 
     def _inc(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -172,6 +179,34 @@ class FaultInjector:
             add_event("fault_injected", site="host", kind="stall",
                       seconds=spec.stall_s)
             time.sleep(spec.stall_s)
+
+    # --- registry site (artifact/registry.py fetch_blob) ---
+
+    def on_blob_chunk(self, digest: str, offset: int) -> None:
+        """registry-flaky scenario: consulted once per received blob
+        chunk. Drops the stream mid-body — past the first chunk, so
+        there is real progress to resume — until
+        ``blob_drop_first`` faults have fired (-1 = every stream,
+        which exhausts the retry budget). The raised fault is an
+        OSError, so the fetch engine treats it as a torn connection
+        and retries with a Range resume."""
+        spec = self.spec
+        if not spec.wants_registry_faults():
+            return
+        self._inc("blob_chunks")
+        if offset <= 0:
+            return
+        with self._lock:
+            if spec.blob_drop_first != -1 and \
+                    self.counters["blob_stream_faults"] >= \
+                    spec.blob_drop_first:
+                return
+            self.counters["blob_stream_faults"] += 1
+        add_event("fault_injected", site="registry",
+                  kind="stream-drop", digest=digest, offset=offset)
+        raise RegistryStreamFault(
+            f"injected mid-body stream drop for {digest} "
+            f"at offset {offset}")
 
     # --- device site ---
 

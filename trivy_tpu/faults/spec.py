@@ -69,6 +69,13 @@ class FaultSpec:
     #    deadline (the spec only carries the number)
     deadline_s: float = 0.0
 
+    # -- flaky registry (artifact/registry.py streaming fetch): the
+    #    first N blob streams are dropped mid-body (one connection
+    #    drop each, past the first chunk) — the resumable fetch must
+    #    recover via Range (or an offset-0 rewrite when the registry
+    #    rejects ranges) without failing the scan
+    blob_drop_first: int = 0
+
     # -- hostile-ingest corpus (faults/hostile.py): builder names —
     #    or ("all",) — materialized (seeded by ``seed``) and appended
     #    to the scanned fleet by the multi-target image path; the
@@ -142,6 +149,9 @@ class FaultSpec:
     def wants_event_storm(self) -> bool:
         return bool(self.storm_events)
 
+    def wants_registry_faults(self) -> bool:
+        return bool(self.blob_drop_first)
+
 
 # Named presets. ``standard-outage`` is the bench/acceptance scenario:
 # a cache outage long enough to trip the breaker and recover, one
@@ -168,6 +178,7 @@ SCENARIOS: dict = {
                      "flood_n": 256},
     "replica-kill": {"replica_kill_after": 32},
     "replica-flaky": {"replica_flaky_every": 3},
+    "registry-flaky": {"blob_drop_first": 2},
     "event-storm": {"storm_events": 256, "storm_digests": 8,
                     "storm_malformed": 8},
 }

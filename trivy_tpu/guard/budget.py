@@ -296,6 +296,33 @@ class ResourceBudget:
                 f"archive entry count exceeds budget "
                 f"(> {self.limits.max_files})")
 
+    def roll_up(self, bytes_n: int = 0, entries_n: int = 0) -> None:
+        """Aggregate a child (per-layer) budget's charges into this
+        per-target budget. Unlike the single-writer hot-path charges
+        above, roll-ups arrive concurrently from streaming prefetch
+        workers, so the counters move under the lock; the cap checks
+        run outside it (a trip raises, and ``_trip`` takes the
+        metrics lock). Global metrics are NOT incremented here — the
+        child budget already counted the same bytes/entries — and
+        the ratio tripwire stays with the child, which knows its own
+        compressed input size."""
+        if bytes_n <= 0 and entries_n <= 0:
+            return
+        with self._lock:
+            self.decompressed += bytes_n
+            self.entries += entries_n
+            total_bytes = self.decompressed
+            total_entries = self.entries
+        lim = self.limits
+        if bytes_n > 0 and total_bytes > lim.max_decompressed_bytes:
+            self.exceeded(
+                f"decompressed bytes exceed budget "
+                f"({total_bytes} > {lim.max_decompressed_bytes})")
+        if entries_n > 0 and total_entries > lim.max_files:
+            self.exceeded(
+                f"archive entry count exceeds budget "
+                f"(> {lim.max_files})")
+
     def check_file_size(self, size: int, path: str = "") -> None:
         if size < 0:
             self.malformed(f"negative member size for {path!r}")
@@ -309,6 +336,50 @@ class ResourceBudget:
             return {"decompressed": self.decompressed,
                     "entries": self.entries,
                     "soft_faults": len(self.soft_faults)}
+
+
+class LayerBudget(ResourceBudget):
+    """A per-layer sub-budget for the streaming ingest path that
+    rolls every charge up to the per-target parent budget.
+
+    Two bounds hold simultaneously, neither weakened by streaming:
+    the layer trips at the same thresholds a materialized scan of
+    that layer alone would (same limits, same ratio tripwire armed
+    with the layer's own compressed size), AND the aggregate across
+    all of an image's layers still respects the per-target cap via
+    the parent roll-up. Global :data:`GUARD_METRICS` are counted
+    once — by this child budget's charges; :meth:`ResourceBudget.
+    roll_up` deliberately skips them. Soft faults delegate to the
+    parent so degraded-mode reporting sees one list per target on
+    both runner paths, and the hot-path charges stay single-writer
+    per layer (one prefetch worker per layer)."""
+
+    def __init__(self, parent: ResourceBudget, name: str = ""):
+        self.parent = parent
+        super().__init__(parent.limits, name=name or parent.name,
+                         metrics=parent.metrics)
+
+    def charge_decompressed(self, n: int,
+                            compressed_total: int = 0) -> None:
+        super().charge_decompressed(n, compressed_total)
+        try:
+            self.parent.roll_up(bytes_n=n)
+        except GuardError:
+            self._flush_metrics()
+            raise
+
+    def charge_entries(self, n: int) -> None:
+        if n <= 0:
+            return
+        super().charge_entries(n)
+        try:
+            self.parent.roll_up(entries_n=n)
+        except GuardError:
+            self._flush_metrics()
+            raise
+
+    def note(self, kind: str, message: str) -> None:
+        self.parent.note(kind, message)
 
 
 class _BudgetContext:

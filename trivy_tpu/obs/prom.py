@@ -322,6 +322,39 @@ def render_prometheus(stats: dict, phase_hists=None,
                  "DFA-table dispatches served per HBM upload.",
                  secret.get("dfa_upload_amortization"))
 
+    ingest = stats.get("ingest") or {}
+    if ingest:
+        # streaming-ingest counters (docs/performance.md §9):
+        # per-key scalars so the warm-skip and resume behavior are
+        # first-class metric names, not labels
+        for k, help_ in (
+                ("streams", "Images opened as streaming sources."),
+                ("layers_fetched",
+                 "Layer blobs fetched over the streaming path."),
+                ("bytes_fetched",
+                 "Compressed layer bytes pulled from registries."),
+                ("layers_skipped",
+                 "Warm layers skipped before their blob GET."),
+                ("bytes_skipped",
+                 "Compressed layer bytes NOT pulled thanks to the "
+                 "warm-layer skip."),
+                ("range_resumes",
+                 "Mid-body drops resumed with an HTTP Range GET."),
+                ("full_restarts",
+                 "Blob fetches rewritten from offset 0 after a "
+                 "rejected Range resume."),
+                ("warm_probe_outages",
+                 "Warm-layer cache probes that failed and degraded "
+                 "to a full pull."),
+                ("cancelled_fetches",
+                 "Layer fetches cancelled mid-stream by a guard "
+                 "budget trip."),
+                ("config_memo_hits",
+                 "Image config blobs served from the digest memo "
+                 "without a GET.")):
+            w.scalar(f"{_PREFIX}_ingest_{k}_total", "counter",
+                     help_, ingest.get(k))
+
     memo = stats.get("memo") or {}
     if memo:
         # findings-memo counters (docs/performance.md "Findings

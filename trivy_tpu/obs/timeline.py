@@ -22,9 +22,16 @@ cause               the device was idle because ...
                        double-buffered staging) and is excluded
                        from this cause entirely: the idle it covers
                        falls through to the next matching cause
+``fetch_serialized``   streaming-ingest staging ran (``fetch`` /
+                       ``decompress`` spans, artifact/stream.py)
+                       with zero device overlap — the same
+                       pipelined-vs-serialized rule as uploads: a
+                       fetch concurrent with device compute is
+                       excluded from this cause entirely
 ``host_pack_bound``    the host was producing the next batch
                        (``pack`` / ``analyze`` / ``join`` /
-                       ``memo_lookup`` / ``delta_rematch`` spans)
+                       ``memo_lookup`` / ``layer_analyze`` /
+                       ``delta_rematch`` spans)
 ``collect_bound``      the host was consuming the previous batch
                        (``decode`` / ``report`` / ``finish`` /
                        ``memo_store`` spans)
@@ -73,11 +80,18 @@ DEVICE_BUSY = frozenset({"device_compute", "dfa_scan"})
 CAUSE_SPANS = (
     ("upload_serialized", frozenset({"h2d_upload", "db_upload",
                                      "dfa_upload"})),
+    # streaming-ingest staging (artifact/stream.py): registry blob
+    # fetch + bounded inflate. Same overlapped-span rule as uploads —
+    # a fetch running while the device computes is pipelined staging,
+    # excluded from this cause entirely
+    ("fetch_serialized", frozenset({"fetch", "decompress"})),
     # memo_lookup (hit/miss partition) and delta_rematch (hot-swap
     # migration) are host work that gates the next dispatch;
-    # memo_store is finish-side bookkeeping (trivy_tpu.memo)
+    # memo_store is finish-side bookkeeping (trivy_tpu.memo);
+    # layer_analyze is the per-layer walk+analyzer stage of the
+    # streaming pipeline (a sub-phase of analyze)
     ("host_pack_bound", frozenset({"pack", "analyze", "join",
-                                   "memo_lookup",
+                                   "memo_lookup", "layer_analyze",
                                    "delta_rematch"})),
     ("collect_bound", frozenset({"decode", "verify", "report",
                                  "finish", "memo_store"})),
@@ -93,6 +107,13 @@ CAUSE_SPANS = (
 # above): only spans in this set that never ran concurrently with a
 # busy interval count toward upload_serialized
 _UPLOAD_SPANS = CAUSE_SPANS[0][1]
+
+# causes whose spans are pipelined staging when they overlap device
+# compute — only the zero-busy-overlap spans keep their cause
+# priority (upload_serialized since PR 11, fetch_serialized since
+# the streaming-ingest PR)
+_SERIALIZED_ONLY_CAUSES = frozenset({"upload_serialized",
+                                     "fetch_serialized"})
 
 # any open root ("scan") span means the scanner had work somewhere;
 # idle not explained above becomes unknown instead of queue_empty
@@ -176,7 +197,7 @@ class Timeline:
             (cause,
              _merge(self._serialized_only(
                  [iv for n in names for iv in by_name.get(n, ())]))
-             if names is _UPLOAD_SPANS else
+             if cause in _SERIALIZED_ONLY_CAUSES else
              _merge([iv for n in names
                      for iv in by_name.get(n, ())]))
             for cause, names in CAUSE_SPANS]

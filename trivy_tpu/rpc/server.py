@@ -669,6 +669,12 @@ class ScanServer:
             # report them too
             from ..memo.metrics import MEMO_METRICS
             out["memo"] = MEMO_METRICS.snapshot()
+        if "ingest" not in out:
+            # streaming-ingest counters (layers fetched/warm-skipped,
+            # Range resumes, cancelled fetches — docs/performance.md
+            # §9), identical section shape on both sched modes
+            from ..artifact.stream import INGEST_METRICS
+            out["ingest"] = INGEST_METRICS.snapshot()
         if self.memo is not None:
             out["memo"] = self.memo.stats()
         if "watch" not in out:

@@ -199,6 +199,83 @@ class BatchScanRunner:
         with defer_gc():
             return self._scan_images(images, options)
 
+    def blob_keyer(self, scan_secrets: bool = True):
+        """Warm-layer probe keyer for ``artifact.stream.stream_image``:
+        computes the SAME ``(artifact_id, blob_ids, base)`` this
+        runner's inspect will scan under — same artifact option, same
+        secret-rules fingerprint — from image *metadata* alone, so the
+        streaming path can skip the blob GET for every layer the
+        cache already holds. A mismatched keyer would skip layers
+        inspect then reports missing (a failed scan), which is why
+        this lives on the runner instead of the stream module."""
+        opt = self._image_opt(scan_secrets)
+
+        def keyer(img):
+            a = ImageArtifact(img, self.cache, opt,
+                              budget=getattr(img, "ingest_budget",
+                                             None))
+            return a.cache_keys()
+
+        return keyer
+
+    def scan_registry_refs(self, refs: list, client=None,
+                           options: Optional[ScanOptions] = None,
+                           streaming: bool = True) -> list:
+        """Scan images straight from a registry — the cold-wall path
+        (docs/performance.md §9). With ``streaming`` (the default)
+        each ref becomes a :class:`~..artifact.stream.\
+StreamingImageSource`: layer blobs decompress into the scan as they
+        arrive, warm layers skip their GET entirely, and the per-layer
+        pipeline overlaps the fleet's device work on both execution
+        paths. ``streaming=False`` is the materialize-first baseline
+        (``DistributionClient.pull``) the bench compares against."""
+        from ..artifact.registry import DistributionClient
+        from ..artifact.stream import stream_image
+        if client is None:
+            client = DistributionClient()
+        # the registry stream is a failure domain of its own
+        # (registry-flaky scenario): thread the runner's injector
+        # into the blob fetch engine
+        client.fault_injector = self.fault_injector
+        options = options or ScanOptions(backend=self.backend)
+        scan_secrets = "secret" in options.security_checks
+        keyer = self.blob_keyer(scan_secrets)
+
+        def load(ref, budget):
+            if not streaming:
+                return client.pull(ref, budget=budget)
+            return stream_image(client, ref, cache=self.cache,
+                                keyer=keyer, budget=budget)
+
+        if self.sched == "on":
+            return self._scan_scheduled([(r, None) for r in refs],
+                                        options, loader=load)
+        sources, failures = [], {}
+        for i, ref in enumerate(refs):
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector.on_image_load(ref)
+                sources.append((i, load(
+                    ref, self._ingest_budget(ref))))
+            except (OSError, ValueError) as e:
+                # RegistryError is a ValueError; GuardError keeps its
+                # typed ingest stage/kind through _failed_slot
+                failures[i] = _failed_slot(ref, e)
+        try:
+            results = self.scan_images(
+                [src for _, src in sources], options)
+        finally:
+            for _, src in sources:
+                try:
+                    src.close()
+                except Exception:   # noqa: BLE001 — cleanup only
+                    log.debug("source close failed",
+                              exc_info=True)
+        out = dict(failures)
+        for (i, _), res in zip(sources, results):
+            out[i] = res
+        return [out[i] for i in range(len(refs))]
+
     def _ingest_budget(self, name: str):
         """Fresh per-target ResourceBudget (docs/robustness.md), or
         None when the runner's artifact option disabled the guards
@@ -236,13 +313,14 @@ class BatchScanRunner:
     # --- the scheduled (continuous-batching) route ---
 
     def _scan_scheduled(self, items: list,
-                        options: Optional[ScanOptions] = None)\
-            -> list:
+                        options: Optional[ScanOptions] = None,
+                        loader=None) -> list:
         """``items``: [(name, image-or-None)] — None loads the path
-        lazily inside analyze(). Submits one request per image to the
-        scheduler and gathers results in input order; per-request
-        failures (load errors, deadline expiry) fail their own slot,
-        never the fleet."""
+        (or, with ``loader``, the registry ref) lazily inside
+        analyze(). Submits one request per image to the scheduler and
+        gathers results in input order; per-request failures (load
+        errors, deadline expiry) fail their own slot, never the
+        fleet."""
         import time as _time
 
         from ..sched import RateLimitedError
@@ -251,7 +329,8 @@ class BatchScanRunner:
         sched = self.scheduler
         reqs = []
         for name, img in items:
-            req = self._image_request(sched, name, img, options)
+            req = self._image_request(sched, name, img, options,
+                                      loader=loader)
             while True:
                 try:
                     reqs.append(sched.submit(req, block=True))
@@ -303,7 +382,8 @@ class BatchScanRunner:
 
     def _image_request(self, sched, name: str, image, options,
                        tenant: str = "", priority: int = 0,
-                       trace_id: str = "", parent_span_id: str = ""):
+                       trace_id: str = "", parent_span_id: str = "",
+                       loader=None):
         from ..sched import AnalyzedWork, ScanRequest
 
         scan_secrets = "secret" in options.security_checks
@@ -332,8 +412,18 @@ class BatchScanRunner:
                         _rel()
                 req.on_done = _done
             budget = self._ingest_budget(name)
-            img = image if image is not None \
-                else load_image(name, budget=budget)
+            # loader: registry seam (scan_registry_refs) — builds a
+            # StreamingImageSource (or a pulled one) instead of
+            # opening a local tar; either way the image is loaded
+            # INSIDE analyze so manifest/config fetches overlap
+            # device execution like tar walking does
+            if image is not None:
+                img = image
+            elif loader is not None:
+                img = loader(name, budget)
+            else:
+                img = load_image(name, budget=budget)
+            owns_img = image is None
             opt = self._image_opt(scan_secrets)
             a = _SchedImageArtifact(img, self.cache, opt,
                                     budget=budget)
@@ -344,7 +434,20 @@ class BatchScanRunner:
             # dependency that guards it
             a._sched = sched
             a._sched_req = req
-            ref = a.inspect()
+            try:
+                ref = a.inspect()
+            finally:
+                if owns_img:
+                    # after inspect every analyzed byte lives in the
+                    # cache; release the source now (a streaming
+                    # source's layer spool can be whole decompressed
+                    # layers on disk, and a fleet of leaked spools
+                    # outlives the scan)
+                    try:
+                        img.close()
+                    except Exception:   # noqa: BLE001 — cleanup
+                        log.debug("source close failed for %r",
+                                  name, exc_info=True)
             a.reference = ref
             if a.budget is not None:
                 # survivable hostile input (e.g. a corrupt rpmdb):
@@ -431,11 +534,13 @@ class BatchScanRunner:
         options = options or ScanOptions(backend=self.backend)
         scan_secrets = "secret" in options.security_checks
 
-        # ---- phase 1: analyze missing layers, collect candidates ----
+        # ---- phase 1: analyze missing layers, collect candidates,
+        # squash + join PER IMAGE ----
         # tracing (docs/observability.md): the direct path has no
         # queue, so each image's span tree is analyze → device (the
         # fleet-shared dispatch window) → report
         tracer = self.tracer
+        from ..obs.trace import activate_or_null, phase_span
         # ambient fleet context (obs/propagate.py): scans launched
         # under an active span (the simhost root, a propagated watch
         # submission) join that trace — per-image roots become its
@@ -443,10 +548,12 @@ class BatchScanRunner:
         # is byte-identical to the single-process path
         from ..obs.propagate import current_context
         amb = current_context()
-        t0 = _time.perf_counter()
         slots, failures = [], {}     # [(input idx, artifact)]
         roots: dict = {}             # input idx -> root span
         opt = self._image_opt(scan_secrets)
+        scanner = LocalScanner(self.cache, db, memo=self.memo)
+        prepared = []                # aligned with slots
+        analyze_s = join_s = 0.0
         for idx, img in enumerate(images):
             name = getattr(img, "name", "")
             root = tracer.start_request(
@@ -456,6 +563,7 @@ class BatchScanRunner:
             roots[idx] = root
             a = _CollectingImageArtifact(img, self.cache, opt)
             sp = tracer.child(root, "analyze")
+            t1 = _time.perf_counter()
             try:
                 with sp.activate():
                     a.reference = a.inspect()
@@ -469,10 +577,27 @@ class BatchScanRunner:
                 failures[idx] = _failed_slot(
                     name, e, trace_id=root.trace_id, tracer=tracer)
                 continue
+            analyze_s += _time.perf_counter() - t1
+            # squash + advisory join for THIS image immediately,
+            # instead of a fleet-wide barrier after every analyze:
+            # with streaming sources, later images' layer fetches
+            # are still in flight on the hostpool while this join
+            # runs — the ISSUE's fetch/join overlap. The join span
+            # keeps the phase visible to idle attribution
+            # (host_pack_bound).
+            t1 = _time.perf_counter()
+            ref = a.reference
+            # prepare emits its own "join" phase span (scan/local.py)
+            with sp.activate():
+                prepared.append(scanner.prepare(
+                    ScanTarget(name=ref.name,
+                               artifact_id=ref.id,
+                               blob_ids=ref.blob_ids),
+                    options))
+            join_s += _time.perf_counter() - t1
             sp.end()
             slots.append((idx, a))
         artifacts = [a for _, a in slots]
-        analyze_s = _time.perf_counter() - t0
         # one shared device window per surviving image: the sieve
         # and interval dispatches below serve the whole fleet, so
         # every slot's "device" span brackets the same wall interval
@@ -481,12 +606,12 @@ class BatchScanRunner:
                      for idx, _ in slots}
 
         # ---- phase 2a: ENQUEUE the sieve dispatch (async) ----
-        # the packing + enqueue runs on the host pool so the squash/
-        # join below overlaps the SEGMENT PACKING too, not just the
-        # device execution behind it; results are collected in 2b —
-        # apply_layers' secret merge is re-derived afterwards via
-        # applier.merge_layer_secrets, which is exactly the secret
-        # part of the squash
+        # the packing + enqueue runs on the host pool so the interval
+        # enqueue below overlaps the SEGMENT PACKING too, not just
+        # the device execution behind it; results are collected in
+        # 2b — apply_layers' secret merge is re-derived afterwards
+        # via applier.merge_layer_secrets, which is exactly the
+        # secret part of the squash
         from .hostpool import get_host_pool
         t0 = _time.perf_counter()
         collected = [c for a in artifacts for c in a.collected]
@@ -512,24 +637,8 @@ class BatchScanRunner:
                 sieve_handle = _enqueue_sieve(files)
         secret_s = _time.perf_counter() - t0
 
-        # ---- phase 3: squash + advisory join (host) ----
-        from ..obs.trace import activate_or_null, phase_span
-        t0 = _time.perf_counter()
-        scanner = LocalScanner(self.cache, db, memo=self.memo)
-        prepared = []
-        # the join span makes this host phase visible to the idle-
-        # attribution timeline (host_pack_bound — the device waits
-        # while the host produces the interval jobs)
-        with activate_or_null(sp0):
-            with phase_span("join", images=len(artifacts)):
-                for a in artifacts:
-                    ref = a.reference
-                    prepared.append(scanner.prepare(
-                        ScanTarget(name=ref.name,
-                                   artifact_id=ref.id,
-                                   blob_ids=ref.blob_ids),
-                        options))
-        join_s = _time.perf_counter() - t0
+        # (the old phase-3 fleet-wide squash/join barrier now runs
+        # per image inside phase 1, overlapping in-flight fetches)
 
         # ---- phase 4a: ENQUEUE the interval waves (async) ----
         # the slot runtime (docs/performance.md §8): dedup + wave

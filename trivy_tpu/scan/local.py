@@ -88,7 +88,19 @@ class LocalScanner:
                 options: ScanOptions) -> PreparedScan:
         """ApplyLayers + advisory name-join → pair jobs. No kernel
         work happens here, so a batch runner can merge many targets'
-        jobs into one dispatch."""
+        jobs into one dispatch — and with streaming ingest the
+        runner calls prepare per image as soon as ITS layers have
+        analyzed, overlapping the join with later images' in-flight
+        fetches. The ``join`` phase span lives here, not in the
+        callers, so idle attribution (host_pack_bound) sees the
+        squash/name-join identically on the direct and scheduled
+        paths."""
+        from ..obs.trace import phase_span
+        with phase_span("join", blobs=len(target.blob_ids)):
+            return self._prepare(target, options)
+
+    def _prepare(self, target: ScanTarget,
+                 options: ScanOptions) -> PreparedScan:
         blobs = [self.cache.get_blob(b) for b in target.blob_ids]
         detail = apply_layers(blobs)
 
